@@ -1,0 +1,29 @@
+"""merklekv_tpu — a TPU-native distributed key-value store framework.
+
+A ground-up rebuild of the capabilities of MerkleKV (a Rust eventually
+consistent KV store; see /root/reference) designed TPU-first:
+
+- The client-facing text protocol, storage engines, replication and
+  anti-entropy *semantics* match the reference (SURVEY.md §2.2, §3).
+- The anti-entropy data plane — bulk leaf hashing, Merkle tree build,
+  N-replica diff — runs as batched JAX/XLA/Pallas programs over sorted
+  keyspace tensors instead of per-key host loops
+  (reference: src/store/merkle.rs, src/sync.rs).
+- Multi-chip scale comes from `jax.sharding.Mesh` + `shard_map` with XLA
+  collectives over ICI (keyspace blocked across devices), not host RPC.
+
+Layout:
+  merkle/    — hash-tree core: CPU golden impl, JAX/TPU engines
+  ops/       — device kernels: SHA-256 (jnp + Pallas), tree reduce, diff
+  parallel/  — mesh construction, sharded rebuild/diff
+  store/     — host KV engines (memory / sharded / persistent / native C++)
+  protocol/  — text protocol parser + response formatting
+  server/    — asyncio TCP server, stats, dispatch
+  replication/ — change events, codecs, LWW applier, event bus transports
+  sync/      — anti-entropy manager
+  utils/     — logging, tracing, metrics
+"""
+
+from merklekv_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,26 @@
+"""Merkle hash-tree core.
+
+`encoding` pins the byte-level hash spec (shared by CPU and TPU engines);
+`cpu` is the golden host implementation. The pluggable MerkleEngine seam the
+anti-entropy subsystem programs against (analog of the reference's
+storage-engine plugin boundary, /root/reference/src/store/mod.rs) lives in
+`merklekv_tpu.merkle.engine` once the TPU engine lands.
+"""
+
+from merklekv_tpu.merkle.encoding import (
+    EMPTY_ROOT_HEX,
+    encode_leaf,
+    leaf_hash,
+    node_hash,
+)
+from merklekv_tpu.merkle.cpu import MerkleTree, build_levels, root_from_leaf_hashes
+
+__all__ = [
+    "EMPTY_ROOT_HEX",
+    "encode_leaf",
+    "leaf_hash",
+    "node_hash",
+    "MerkleTree",
+    "build_levels",
+    "root_from_leaf_hashes",
+]

@@ -1,0 +1,187 @@
+"""Golden CPU Merkle tree.
+
+Semantics-equal to the reference tree (/root/reference/src/store/merkle.rs):
+leaves sorted lexicographically by key (byte order), pairwise bottom-up
+combination, odd trailing node promoted unchanged, flat leaf-map diff.
+
+Two deliberate departures from the reference *implementation* (roots are
+still bit-identical):
+
+- **Lazy rebuild.** The reference rebuilds the whole tree on every
+  insert/remove (merkle.rs:52-62), making an n-key snapshot O(n^2 log n)
+  hashing. Here mutations only touch the leaf map; levels are rebuilt once,
+  on demand.
+- **Flat level arrays.** The tree is a list of levels of 32-byte hashes,
+  not linked nodes — the same layout the TPU engine uses, so parity tests
+  can compare any level, not just the root. Structural views
+  (preorder_hashes / node_count) are derived from the level layout.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from merklekv_tpu.merkle.encoding import EMPTY_ROOT_HEX, leaf_hash, node_hash
+
+
+def _sort_key(k: str) -> bytes:
+    # Rust `String::cmp` is byte-wise over UTF-8; UTF-8 byte order equals
+    # code-point order, but sorting on the encoded bytes makes that explicit.
+    return k.encode("utf-8")
+
+
+def build_levels(leaf_hashes: list[bytes]) -> list[list[bytes]]:
+    """Bottom-up levels from sorted leaf hashes. levels[0] is the leaves;
+    levels[-1] is [root]. Odd trailing nodes are promoted (copied up)."""
+    if not leaf_hashes:
+        return []
+    levels = [list(leaf_hashes)]
+    while len(levels[-1]) > 1:
+        cur = levels[-1]
+        nxt = [node_hash(cur[i], cur[i + 1]) for i in range(0, len(cur) - 1, 2)]
+        if len(cur) % 2:
+            nxt.append(cur[-1])
+        levels.append(nxt)
+    return levels
+
+
+def root_from_leaf_hashes(leaf_hashes: list[bytes]) -> Optional[bytes]:
+    levels = build_levels(leaf_hashes)
+    return levels[-1][0] if levels else None
+
+
+class MerkleTree:
+    """In-memory Merkle tree over a (key -> leaf hash) map."""
+
+    def __init__(self) -> None:
+        self._leaf_map: dict[str, bytes] = {}
+        self._levels: list[list[bytes]] = []
+        self._dirty = False
+
+    # ------------------------------------------------------------ mutation
+
+    def insert(self, key: str, value: str | bytes) -> None:
+        self._leaf_map[key] = leaf_hash(key, value)
+        self._dirty = True
+
+    def insert_hash(self, key: str, hash32: bytes) -> None:
+        """Insert a precomputed leaf hash (used when only hashes travel)."""
+        if len(hash32) != 32:
+            raise ValueError("leaf hash must be 32 bytes")
+        self._leaf_map[key] = hash32
+        self._dirty = True
+
+    def remove(self, key: str) -> None:
+        if self._leaf_map.pop(key, None) is not None:
+            self._dirty = True
+
+    def clear(self) -> None:
+        if self._leaf_map:
+            self._leaf_map.clear()
+            self._dirty = True
+
+    @classmethod
+    def from_items(cls, items: Iterable[tuple[str, str | bytes]]) -> "MerkleTree":
+        t = cls()
+        for k, v in items:
+            t.insert(k, v)
+        return t
+
+    # ------------------------------------------------------------ queries
+
+    def __len__(self) -> int:
+        return len(self._leaf_map)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._leaf_map
+
+    def leaf_hash_of(self, key: str) -> Optional[bytes]:
+        return self._leaf_map.get(key)
+
+    def _rebuild(self) -> None:
+        if not self._dirty:
+            return
+        ordered = sorted(self._leaf_map.items(), key=lambda kv: _sort_key(kv[0]))
+        self._levels = build_levels([h for _, h in ordered])
+        self._dirty = False
+
+    @property
+    def levels(self) -> list[list[bytes]]:
+        self._rebuild()
+        return self._levels
+
+    def root_hash(self) -> Optional[bytes]:
+        self._rebuild()
+        return self._levels[-1][0] if self._levels else None
+
+    def root_hex(self) -> str:
+        r = self.root_hash()
+        return r.hex() if r is not None else EMPTY_ROOT_HEX
+
+    # ------------------------------------------------------------ views
+
+    def inorder_keys(self) -> list[str]:
+        return sorted(self._leaf_map.keys(), key=_sort_key)
+
+    def leaves(self) -> list[tuple[str, bytes]]:
+        return sorted(self._leaf_map.items(), key=lambda kv: _sort_key(kv[0]))
+
+    def node_count(self) -> int:
+        """Nodes in the materialized tree (promoted nodes counted once),
+        matching the reference's linked-node count (merkle.rs:155-163)."""
+        self._rebuild()
+        if not self._levels:
+            return 0
+        count = len(self._levels[0])
+        for lo in self._levels[:-1]:
+            # Each full pair at this level yields one new parent node;
+            # a promoted odd tail is the same node, not a new one.
+            count += len(lo) // 2
+        return count
+
+    def preorder_hashes(self) -> list[bytes]:
+        """Root -> left subtree -> right subtree over the implicit structure.
+
+        A promoted node at level l+1 shares identity with its level-l
+        origin, so traversal descends through promotions without re-emitting
+        them (parity with the reference's cloned-node traversal).
+        """
+        self._rebuild()
+        if not self._levels:
+            return []
+        out: list[bytes] = []
+
+        def go(level: int, idx: int) -> None:
+            out.append(self._levels[level][idx])
+            if level == 0:
+                return
+            lo = self._levels[level - 1]
+            li, ri = 2 * idx, 2 * idx + 1
+            if ri < len(lo):
+                go(level - 1, li)
+                go(level - 1, ri)
+            else:
+                # Promotion: same node one level down; skip the duplicate
+                # emission and descend directly to its children.
+                drop = out.pop()
+                assert drop == lo[li]
+                go(level - 1, li)
+
+        go(len(self._levels) - 1, 0)
+        return out
+
+    # ------------------------------------------------------------ diff
+
+    def diff_keys(self, other: "MerkleTree") -> list[str]:
+        """Exact set of differing keys, sorted: present in only one tree, or
+        present in both with different leaf hashes
+        (reference: merkle.rs:171-196)."""
+        diffs: list[str] = []
+        for k in sorted(self._leaf_map.keys() | other._leaf_map.keys(), key=_sort_key):
+            if self._leaf_map.get(k) != other._leaf_map.get(k):
+                diffs.append(k)
+        return diffs
+
+    def diff_first_key(self, other: "MerkleTree") -> Optional[str]:
+        d = self.diff_keys(other)
+        return d[0] if d else None

@@ -1,0 +1,50 @@
+"""Byte-level Merkle hash specification.
+
+This module is the single source of truth for how (key, value) pairs become
+leaf hashes and how sibling hashes combine into parent hashes. Both the CPU
+golden implementation and the TPU (JAX/Pallas) engines derive from this spec,
+so their roots are bit-identical.
+
+Spec (matches the reference semantics, /root/reference/src/store/merkle.rs:7-16,45-49,96-103):
+
+  leaf_bytes(k, v) = u32_be(len(k)) || k || u32_be(len(v)) || v
+  leaf_hash(k, v)  = SHA256(leaf_bytes(k, v))
+  node_hash(l, r)  = SHA256(l || r)            # l, r are 32-byte child hashes
+
+Length-prefixing makes the encoding injective for arbitrary bytes (NUL,
+unicode, empty strings), so distinct (k, v) pairs can never collide by
+concatenation ambiguity.
+
+The empty tree has no root; the protocol's `HASH` command renders it as 64
+ASCII zeros (reference: src/server.rs:671-675).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+EMPTY_ROOT_HEX = "0" * 64
+
+_U32_BE = struct.Struct(">I")
+
+
+def _as_bytes(s: str | bytes) -> bytes:
+    return s.encode("utf-8") if isinstance(s, str) else s
+
+
+def encode_leaf(key: str | bytes, value: str | bytes) -> bytes:
+    """Injective length-prefixed encoding of a (key, value) pair."""
+    kb = _as_bytes(key)
+    vb = _as_bytes(value)
+    return b"".join((_U32_BE.pack(len(kb)), kb, _U32_BE.pack(len(vb)), vb))
+
+
+def leaf_hash(key: str | bytes, value: str | bytes) -> bytes:
+    """32-byte SHA-256 leaf hash of a (key, value) pair."""
+    return hashlib.sha256(encode_leaf(key, value)).digest()
+
+
+def node_hash(left: bytes, right: bytes) -> bytes:
+    """32-byte SHA-256 parent hash of two 32-byte child hashes."""
+    return hashlib.sha256(left + right).digest()

@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
+available in CI); the env vars must be set before jax is first imported, so
+this conftest sets them at collection time. Bench runs (bench.py) are separate
+and use the real TPU chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line("markers", "benchmark: performance test")
+    config.addinivalue_line("markers", "integration: spawns real server processes")

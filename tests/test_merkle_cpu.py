@@ -1,0 +1,246 @@
+"""Golden tests for the CPU Merkle core.
+
+Mirrors the reference's inline Merkle suite (/root/reference/src/store/merkle.rs:207-1184):
+determinism across insertion orders, manual root reconstruction, odd-leaf
+promotion shape, NUL/unicode robustness, diff correctness under seeded random
+mutation, and a delete/restore stress run.
+"""
+
+import hashlib
+import random
+import struct
+
+import pytest
+
+from merklekv_tpu.merkle import (
+    EMPTY_ROOT_HEX,
+    MerkleTree,
+    build_levels,
+    encode_leaf,
+    leaf_hash,
+    node_hash,
+)
+
+
+def manual_leaf(key: str, value: str) -> bytes:
+    kb, vb = key.encode(), value.encode()
+    buf = struct.pack(">I", len(kb)) + kb + struct.pack(">I", len(vb)) + vb
+    return hashlib.sha256(buf).digest()
+
+
+class TestEncoding:
+    def test_leaf_encoding_is_length_prefixed(self):
+        assert encode_leaf("a", "b") == b"\x00\x00\x00\x01a\x00\x00\x00\x01b"
+
+    def test_leaf_encoding_injective_on_ambiguous_concat(self):
+        # "a:" + ":b" vs "a" + "::b" would collide under naive concat
+        assert encode_leaf("a:", ":b") != encode_leaf("a", "::b")
+        assert leaf_hash("a:", ":b") != leaf_hash("a", "::b")
+
+    def test_leaf_hash_matches_manual(self):
+        assert leaf_hash("key1", "value1") == manual_leaf("key1", "value1")
+
+    def test_nul_and_unicode(self):
+        assert leaf_hash("k\x00ey", "v") != leaf_hash("key", "\x00v")
+        assert leaf_hash("héllo", "wörld") == manual_leaf("héllo", "wörld")
+
+    def test_empty_key_value(self):
+        assert leaf_hash("", "") == hashlib.sha256(b"\x00" * 8).digest()
+
+
+class TestBuild:
+    def test_empty_tree(self):
+        t = MerkleTree()
+        assert t.root_hash() is None
+        assert t.root_hex() == EMPTY_ROOT_HEX
+        assert t.node_count() == 0
+        assert t.preorder_hashes() == []
+
+    def test_single_leaf_root_is_leaf_hash(self):
+        t = MerkleTree()
+        t.insert("k", "v")
+        assert t.root_hash() == leaf_hash("k", "v")
+        assert t.node_count() == 1
+
+    def test_two_leaf_manual_reconstruction(self):
+        t = MerkleTree.from_items([("a", "1"), ("b", "2")])
+        expected = node_hash(leaf_hash("a", "1"), leaf_hash("b", "2"))
+        assert t.root_hash() == expected
+        assert t.node_count() == 3
+
+    def test_four_leaf_manual_reconstruction(self):
+        items = [("a", "1"), ("b", "2"), ("c", "3"), ("d", "4")]
+        t = MerkleTree.from_items(items)
+        l = [leaf_hash(k, v) for k, v in items]
+        expected = node_hash(node_hash(l[0], l[1]), node_hash(l[2], l[3]))
+        assert t.root_hash() == expected
+        assert t.node_count() == 7
+
+    def test_three_leaf_odd_promotion(self):
+        items = [("a", "1"), ("b", "2"), ("c", "3")]
+        t = MerkleTree.from_items(items)
+        l = [leaf_hash(k, v) for k, v in items]
+        # c is promoted unchanged to level 1; root = H(H(a||b) || c)
+        expected = node_hash(node_hash(l[0], l[1]), l[2])
+        assert t.root_hash() == expected
+        assert t.node_count() == 5  # 3 leaves + H(ab) + root
+
+    def test_five_leaf_promotion_chain(self):
+        items = [(c, c) for c in "abcde"]
+        t = MerkleTree.from_items(items)
+        l = [leaf_hash(c, c) for c in "abcde"]
+        lvl1 = [node_hash(l[0], l[1]), node_hash(l[2], l[3]), l[4]]
+        lvl2 = [node_hash(lvl1[0], lvl1[1]), l[4]]
+        expected = node_hash(lvl2[0], lvl2[1])
+        assert t.root_hash() == expected
+
+    def test_determinism_across_insertion_orders(self):
+        items = [(f"key{i}", f"val{i}") for i in range(37)]
+        roots = set()
+        for seed in range(5):
+            shuffled = items[:]
+            random.Random(seed).shuffle(shuffled)
+            roots.add(MerkleTree.from_items(shuffled).root_hash())
+        assert len(roots) == 1
+
+    def test_sorted_by_byte_order(self):
+        # 'Z' < 'a' in byte order; ensure ordering is bytes not locale
+        t1 = MerkleTree.from_items([("Z", "1"), ("a", "2")])
+        expected = node_hash(leaf_hash("Z", "1"), leaf_hash("a", "2"))
+        assert t1.root_hash() == expected
+
+    def test_value_update_changes_root(self):
+        t = MerkleTree.from_items([("a", "1"), ("b", "2")])
+        r1 = t.root_hash()
+        t.insert("a", "CHANGED")
+        assert t.root_hash() != r1
+
+    def test_remove_then_restore_root_roundtrip(self):
+        t = MerkleTree.from_items([(f"k{i}", f"v{i}") for i in range(20)])
+        r = t.root_hash()
+        t.remove("k7")
+        assert t.root_hash() != r
+        t.insert("k7", "v7")
+        assert t.root_hash() == r
+
+    def test_build_levels_shapes(self):
+        hashes = [leaf_hash(str(i), str(i)) for i in range(6)]
+        levels = build_levels(hashes)
+        assert [len(l) for l in levels] == [6, 3, 2, 1]
+
+    def test_preorder_root_first(self):
+        t = MerkleTree.from_items([(c, c) for c in "abc"])
+        pre = t.preorder_hashes()
+        assert pre[0] == t.root_hash()
+        assert len(pre) == t.node_count()
+        # preorder: root, H(ab), a, b, c
+        l = [leaf_hash(c, c) for c in "abc"]
+        assert pre == [t.root_hash(), node_hash(l[0], l[1]), l[0], l[1], l[2]]
+
+    def test_inorder_keys_and_leaves_sorted(self):
+        t = MerkleTree.from_items([("b", "2"), ("a", "1"), ("c", "3")])
+        assert t.inorder_keys() == ["a", "b", "c"]
+        assert [k for k, _ in t.leaves()] == ["a", "b", "c"]
+        assert t.leaves()[0][1] == leaf_hash("a", "1")
+
+
+class TestDiff:
+    def test_identical_trees_no_diff(self):
+        a = MerkleTree.from_items([(f"k{i}", f"v{i}") for i in range(50)])
+        b = MerkleTree.from_items([(f"k{i}", f"v{i}") for i in range(50)])
+        assert a.diff_keys(b) == []
+        assert a.root_hash() == b.root_hash()
+
+    def test_value_divergence_detected(self):
+        a = MerkleTree.from_items([("x", "1"), ("y", "2")])
+        b = MerkleTree.from_items([("x", "1"), ("y", "DIFFERENT")])
+        assert a.diff_keys(b) == ["y"]
+
+    def test_missing_keys_both_directions(self):
+        a = MerkleTree.from_items([("only_a", "1"), ("both", "2")])
+        b = MerkleTree.from_items([("only_b", "3"), ("both", "2")])
+        assert a.diff_keys(b) == ["only_a", "only_b"]
+        assert b.diff_keys(a) == ["only_a", "only_b"]
+
+    def test_diff_first_key(self):
+        a = MerkleTree.from_items([("a", "1"), ("z", "9")])
+        b = MerkleTree.from_items([("a", "X"), ("z", "Y")])
+        assert a.diff_first_key(b) == "a"
+        assert MerkleTree().diff_first_key(MerkleTree()) is None
+
+    def test_seeded_random_divergence(self):
+        rng = random.Random(1234)
+        base = {f"key{i:04d}": f"val{i}" for i in range(300)}
+        a = MerkleTree.from_items(base.items())
+
+        mutated = dict(base)
+        changed = set(rng.sample(sorted(base), 25))
+        removed = set(rng.sample(sorted(base.keys() - changed), 10))
+        added = {f"new{i}": "x" for i in range(7)}
+        for k in changed:
+            mutated[k] = mutated[k] + "_mut"
+        for k in removed:
+            del mutated[k]
+        mutated.update(added)
+        b = MerkleTree.from_items(mutated.items())
+
+        expected = sorted(changed | removed | set(added))
+        assert a.diff_keys(b) == expected
+
+    def test_root_equality_iff_no_diff(self):
+        rng = random.Random(7)
+        for trial in range(20):
+            n = rng.randrange(1, 40)
+            items = {f"k{rng.randrange(100)}": str(rng.random()) for _ in range(n)}
+            other = dict(items)
+            if trial % 2:  # half the trials mutate the copy
+                k = rng.choice(sorted(other))
+                match rng.randrange(3):
+                    case 0:
+                        other[k] = other[k] + "_mut"
+                    case 1:
+                        del other[k]
+                    case 2:
+                        other[f"extra{trial}"] = "x"
+            a = MerkleTree.from_items(items.items())
+            b = MerkleTree.from_items(other.items())
+            assert (a.root_hash() == b.root_hash()) == (a.diff_keys(b) == [])
+            assert (items == other) == (a.diff_keys(b) == [])
+
+
+@pytest.mark.slow
+class TestStress:
+    def test_200_key_delete_restore(self):
+        items = [(f"key{i:03d}", f"value{i}") for i in range(200)]
+        t = MerkleTree.from_items(items)
+        original = t.root_hash()
+        rng = random.Random(99)
+        doomed = rng.sample([k for k, _ in items], 50)
+        for k in doomed:
+            t.remove(k)
+        assert len(t) == 150
+        for k in doomed:
+            t.insert(k, f"value{int(k[3:])}")
+        assert t.root_hash() == original
+
+    def test_incremental_vs_batch_equivalence(self):
+        # Lazy rebuild must equal one-shot build for any mutation sequence.
+        rng = random.Random(5)
+        t = MerkleTree()
+        state: dict[str, str] = {}
+        for step in range(500):
+            k = f"k{rng.randrange(80)}"
+            if rng.random() < 0.3 and state:
+                t.remove(k)
+                state.pop(k, None)
+            else:
+                v = f"v{step}"
+                t.insert(k, v)
+                state[k] = v
+            if step % 97 == 0:
+                fresh = MerkleTree.from_items(state.items())
+                assert t.root_hash() == fresh.root_hash()
+        fresh = MerkleTree.from_items(state.items())
+        assert t.root_hash() == fresh.root_hash()
+        assert t.node_count() == fresh.node_count()
+        assert t.preorder_hashes() == fresh.preorder_hashes()
