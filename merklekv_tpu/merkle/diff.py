@@ -1,0 +1,117 @@
+"""Vectorized multi-replica Merkle diff.
+
+The reference diffs two trees by walking a flat leaf map pairwise on the host
+(/root/reference/src/store/merkle.rs:171-196) and reconciles one peer at a
+time over per-key TCP GETs (/root/reference/src/sync.rs:56-214). Here the
+whole comparison is one XLA program over stacked replica tensors:
+
+  - N replicas' leaf digests are aligned host-side onto the union keyspace
+    (sorted keys; absent keys get a presence-mask 0);
+  - the device computes per-key divergence masks for all replicas against a
+    reference replica simultaneously — [R, N] in one fused elementwise pass;
+  - winners for reconciliation (LWW at a higher layer) come back as index
+    lists, not values — values never travel through the diff.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "AlignedReplicas",
+    "align_replicas",
+    "divergence_masks",
+    "diff_keys_multi",
+    "diff_keys_pair",
+]
+
+
+class AlignedReplicas:
+    """Union-keyspace alignment of R replicas' (key -> leaf digest) maps.
+
+    Attributes:
+      keys:    union keyspace, sorted bytes, length N.
+      digests: [R, N, 8] uint32 — leaf digest per replica/key (0 if absent).
+      present: [R, N] bool — key present in replica r.
+    """
+
+    __slots__ = ("keys", "digests", "present")
+
+    def __init__(self, keys: list[bytes], digests: np.ndarray, present: np.ndarray):
+        self.keys = keys
+        self.digests = digests
+        self.present = present
+
+    @property
+    def n_replicas(self) -> int:
+        return self.digests.shape[0]
+
+    @property
+    def n_keys(self) -> int:
+        return self.digests.shape[1]
+
+
+def align_replicas(replicas: Sequence[dict[bytes, bytes]]) -> AlignedReplicas:
+    """Align R (key -> 32-byte leaf hash) maps onto the sorted union keyspace."""
+    union: set[bytes] = set()
+    for r in replicas:
+        union.update(r.keys())
+    keys = sorted(union)
+    n = len(keys)
+    r_count = len(replicas)
+    idx = {k: i for i, k in enumerate(keys)}
+    digests = np.zeros((r_count, n, 8), np.uint32)
+    present = np.zeros((r_count, n), bool)
+    for ri, rep in enumerate(replicas):
+        for k, h in rep.items():
+            i = idx[k]
+            digests[ri, i] = np.frombuffer(h, ">u4").astype(np.uint32)
+            present[ri, i] = True
+    return AlignedReplicas(keys, digests, present)
+
+
+@jax.jit
+def divergence_masks(digests: jax.Array, present: jax.Array) -> jax.Array:
+    """[R, N] bool: key i diverges between replica r and replica 0.
+
+    A key diverges if presence differs or both present with different
+    digests. Row 0 is all-False by construction.
+    """
+    ref_d = digests[0:1]
+    ref_p = present[0:1]
+    same_digest = jnp.all(digests == ref_d, axis=-1)
+    both_present = present & ref_p
+    return (present != ref_p) | (both_present & ~same_digest)
+
+
+@jax.jit
+def _any_divergent(digests: jax.Array, present: jax.Array) -> jax.Array:
+    """[N] bool: key diverges between ANY pair of replicas (union view)."""
+    masks = divergence_masks(digests, present)
+    return jnp.any(masks, axis=0)
+
+
+def diff_keys_multi(aligned: AlignedReplicas) -> dict[int, list[bytes]]:
+    """Per-replica divergent key lists vs replica 0, computed in one program."""
+    if aligned.n_keys == 0:
+        return {r: [] for r in range(1, aligned.n_replicas)}
+    masks = np.asarray(divergence_masks(aligned.digests, aligned.present))
+    out: dict[int, list[bytes]] = {}
+    for r in range(1, aligned.n_replicas):
+        (ii,) = np.nonzero(masks[r])
+        out[r] = [aligned.keys[i] for i in ii]
+    return out
+
+
+def diff_keys_pair(
+    local: dict[bytes, bytes], remote: dict[bytes, bytes]
+) -> list[bytes]:
+    """Sorted keys differing between two leaf-hash maps (reference
+    merkle.rs:171-196 semantics), via the batched device path."""
+    aligned = align_replicas([local, remote])
+    return diff_keys_multi(aligned).get(1, [])

@@ -1,0 +1,212 @@
+"""TPU Merkle engine: whole-keyspace hashing and tree build as XLA programs.
+
+Replaces the reference's per-insert full rebuild
+(/root/reference/src/store/merkle.rs:52-56,73-121 — O(n^2 log n) hashing per
+snapshot) with:
+
+  1. one batched SHA-256 program over every leaf (``sha256_blocks``), and
+  2. a log-depth bottom-up reduction (``build_levels_device``) whose per-level
+     shapes are static under ``jit``, with the reference's odd-node promotion
+     rule reproduced exactly so roots are bit-identical to the CPU core.
+
+Two build paths:
+- **static** (`tree_root`, `build_levels_device`): shapes specialized on the
+  exact leaf count N. Best throughput; used by the bench and by snapshot-style
+  rebuilds. One compile per distinct N.
+- **capacity** (`tree_root_capacity`): one compiled program per capacity C
+  (power-of-two bucket) valid for any live count n <= C, for serving paths
+  where n changes per batch and recompiles are unacceptable. The dynamic level
+  sizes are carried as traced scalars; promotion is a dynamic scatter.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from merklekv_tpu.merkle.packing import pack_leaves
+from merklekv_tpu.ops.sha256 import (
+    digest_to_bytes,
+    digests_to_bytes,
+    sha256_blocks,
+    sha256_node_pairs,
+)
+
+__all__ = [
+    "leaf_digests",
+    "build_levels_device",
+    "tree_root",
+    "tree_root_capacity",
+    "JaxMerkleTree",
+]
+
+
+# ------------------------------------------------------------ leaf hashing
+
+@jax.jit
+def _leaf_digests_jit(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
+    return sha256_blocks(blocks, nblocks)
+
+
+def leaf_digests(keys: Sequence[bytes], values: Sequence[bytes]) -> jax.Array:
+    """[N, 8] uint32 leaf digests for N (key, value) pairs, hashed on device."""
+    packed = pack_leaves(list(keys), list(values))
+    if packed.n == 0:
+        return jnp.zeros((0, 8), jnp.uint32)
+    return _leaf_digests_jit(packed.blocks, packed.nblocks)
+
+
+# ------------------------------------------------------------ static build
+
+def build_levels_device(leaves: jax.Array) -> list[jax.Array]:
+    """All tree levels, bottom-up, as device arrays. leaves: [N, 8] uint32.
+
+    Trace-time Python loop — level sizes are static for a given N, so the
+    whole tree is one straight-line XLA program of ~log2(N) batched hash
+    calls. Odd trailing nodes are promoted (copied up) exactly like the
+    reference (merkle.rs:111-114).
+    """
+    levels = [leaves]
+    cur = leaves
+    while cur.shape[0] > 1:
+        m = cur.shape[0]
+        pairs = m // 2
+        nxt = sha256_node_pairs(cur[0 : 2 * pairs : 2], cur[1 : 2 * pairs : 2])
+        if m % 2:
+            nxt = jnp.concatenate([nxt, cur[-1:]], axis=0)
+        levels.append(nxt)
+        cur = nxt
+    return levels
+
+
+@jax.jit
+def tree_root(leaves: jax.Array) -> jax.Array:
+    """[8] uint32 root digest from [N, 8] leaf digests (N >= 1, static)."""
+    return build_levels_device(leaves)[-1][0]
+
+
+# jit-of-list-of-levels: one compile per leaf count N, then fast replays.
+build_levels_jit = jax.jit(build_levels_device)
+
+
+# ---------------------------------------------------------- capacity build
+
+@jax.jit
+def tree_root_capacity(leaves: jax.Array, n: jax.Array) -> jax.Array:
+    """Root over the first ``n`` of C leaf slots; one compile per capacity C.
+
+    leaves: [C, 8] uint32 with C a power of two (slots >= n are ignored);
+    n: scalar int32, 1 <= n <= C. Produces the root of the odd-promotion tree
+    of exactly n leaves — bit-identical to ``tree_root(leaves[:n])`` — so a
+    serving path can reuse one compiled program for any live count within a
+    capacity bucket.
+
+    With C a power of two, every dynamic level size m <= C_level keeps the
+    promotion slot m//2 strictly inside the next level's C_level/2 slots, so
+    the dynamic scatter below never aliases a live pair slot.
+    """
+    c = leaves.shape[0]
+    if c & (c - 1):
+        raise ValueError(f"capacity must be a power of two, got {c}")
+    cur = leaves
+    m = jnp.asarray(n, jnp.int32)
+    while cur.shape[0] > 1:
+        half = cur.shape[0] // 2
+        hashed = sha256_node_pairs(cur[0 : 2 * half : 2], cur[1 : 2 * half : 2])
+        # Promote a dynamic odd tail: slot m//2 of the next level gets cur[m-1].
+        odd = (m % 2) == 1
+        last = jax.lax.dynamic_index_in_dim(
+            cur, jnp.maximum(m - 1, 0), axis=0, keepdims=False
+        )
+        is_tgt = (jnp.arange(half, dtype=jnp.int32) == m // 2)[:, None] & odd
+        promoted = jnp.where(is_tgt, last[None, :], hashed)
+        # Levels past the top (m == 1) pass the root through unchanged.
+        done = m <= 1
+        cur = jnp.where(done, cur[:half], promoted)
+        m = jnp.where(done, m, (m + 1) // 2)
+    return cur[0]
+
+
+# ------------------------------------------------------------ engine class
+
+class JaxMerkleTree:
+    """Same surface as the CPU ``MerkleTree`` with device-batched hashing.
+
+    Mutations only touch a host-side (key -> (key_bytes, value_bytes)) map;
+    ``root_hash``/``levels`` trigger one batched device rebuild. Used by the
+    golden parity suite and as the serving engine's snapshot path.
+    """
+
+    def __init__(self) -> None:
+        self._items: dict[bytes, bytes] = {}
+        self._levels_np: Optional[list[np.ndarray]] = None
+
+    # -- mutation ----------------------------------------------------------
+    def insert(self, key: str | bytes, value: str | bytes) -> None:
+        self._items[_b(key)] = _b(value)
+        self._levels_np = None
+
+    def remove(self, key: str | bytes) -> None:
+        if self._items.pop(_b(key), None) is not None:
+            self._levels_np = None
+
+    def clear(self) -> None:
+        if self._items:
+            self._items.clear()
+            self._levels_np = None
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    # -- build -------------------------------------------------------------
+    def _rebuild(self) -> None:
+        if self._levels_np is not None:
+            return
+        if not self._items:
+            self._levels_np = []
+            return
+        ordered = sorted(self._items.items())
+        keys = [k for k, _ in ordered]
+        values = [v for _, v in ordered]
+        leaves = leaf_digests(keys, values)
+        levels = build_levels_jit(leaves)
+        self._levels_np = [np.asarray(lv) for lv in levels]
+
+    @property
+    def levels(self) -> list[np.ndarray]:
+        self._rebuild()
+        assert self._levels_np is not None
+        return self._levels_np
+
+    def root_hash(self) -> Optional[bytes]:
+        self._rebuild()
+        if not self._levels_np:
+            return None
+        return digest_to_bytes(self._levels_np[-1][0])
+
+    def root_hex(self) -> str:
+        r = self.root_hash()
+        return r.hex() if r is not None else "0" * 64
+
+    def inorder_keys(self) -> list[str]:
+        return [k.decode("utf-8", "surrogateescape") for k in sorted(self._items)]
+
+    def leaves(self) -> list[tuple[str, bytes]]:
+        self._rebuild()
+        assert self._levels_np is not None
+        if not self._levels_np:
+            return []
+        hashes = digests_to_bytes(self._levels_np[0])
+        return [
+            (k.decode("utf-8", "surrogateescape"), h)
+            for k, h in zip(sorted(self._items), hashes)
+        ]
+
+
+def _b(s: str | bytes) -> bytes:
+    return s.encode("utf-8") if isinstance(s, str) else s

@@ -1,0 +1,13 @@
+"""Device-side primitive ops (JAX/XLA/Pallas)."""
+
+from merklekv_tpu.ops.sha256 import (
+    sha256_blocks,
+    sha256_node_pairs,
+    sha256_single_block,
+)
+
+__all__ = [
+    "sha256_blocks",
+    "sha256_node_pairs",
+    "sha256_single_block",
+]

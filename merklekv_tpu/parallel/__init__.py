@@ -1,0 +1,9 @@
+"""Multi-device SPMD layer: mesh construction, sharded Merkle build/diff."""
+
+from merklekv_tpu.parallel.mesh import make_mesh
+from merklekv_tpu.parallel.sharded_merkle import (
+    sharded_divergence,
+    sharded_tree_root,
+)
+
+__all__ = ["make_mesh", "sharded_tree_root", "sharded_divergence"]

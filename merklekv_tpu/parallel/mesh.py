@@ -1,0 +1,42 @@
+"""Device-mesh construction for the keyspace data plane.
+
+The reference's inter-node fabric is TCP + an MQTT broker
+(/root/reference/src/sync.rs:152-198, src/replication.rs:115-143). Inside a
+TPU slice the equivalent fabric is ICI: the sorted keyspace is sharded over a
+``key`` mesh axis and replicas over a ``replica`` axis; diff/rebuild
+collectives (all_gather of subtree roots, psum of divergence counts) ride the
+mesh. Across slices/hosts the same program spans DCN via jax distributed
+initialization — the mesh abstraction is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh"]
+
+
+def make_mesh(
+    axis_sizes: Optional[dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over available devices.
+
+    ``axis_sizes`` maps axis name -> size, e.g. ``{"replica": 2, "key": 4}``.
+    Default: all devices on one ``key`` axis (pure keyspace data parallelism).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if axis_sizes is None:
+        axis_sizes = {"key": len(devs)}
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[n] for n in names)
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        raise ValueError(f"mesh needs {total} devices, have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(sizes)
+    return Mesh(arr, names)

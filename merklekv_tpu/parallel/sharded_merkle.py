@@ -1,0 +1,95 @@
+"""SPMD Merkle build and diff over a device mesh (shard_map + collectives).
+
+Decomposition: for N = D * L leaves with L a power of two, the bottom
+log2(L) tree levels never cross a shard boundary — every pair merge is
+inside one contiguous block of L sorted leaves. So each device reduces its
+own [L, 8] leaf block to one subtree root locally (pure pairwise, no
+promotions), the D subtree roots are all_gathered over ICI, and the tiny
+top tree over D nodes is computed redundantly on every device (D-1 hashes).
+The result is bit-identical to the single-device odd-promotion tree of N
+leaves, because D and L are powers of two here.
+
+Divergence is embarrassingly parallel over keys: each device compares its
+[R, L] digest block and psums the per-replica divergence counts so every
+shard returns the global count alongside its local mask block.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from merklekv_tpu.merkle.jax_engine import build_levels_device
+from merklekv_tpu.merkle.diff import divergence_masks
+
+__all__ = ["sharded_tree_root", "sharded_divergence"]
+
+
+def _local_root(block: jax.Array) -> jax.Array:
+    """[L, 8] -> [1, 8] subtree root (L is a power of two)."""
+    return build_levels_device(block)[-1]
+
+
+def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.Array:
+    """Root of the Merkle tree over [N, 8] leaf digests, keyspace-sharded.
+
+    N must equal mesh.shape[axis] * L with L a power of two (pad the
+    keyspace tensor to a bucket boundary before calling). Returns [8] uint32,
+    bit-identical to ``tree_root(leaves)``.
+    """
+    d = mesh.shape[axis]
+    n = leaves.shape[0]
+    if n % d:
+        raise ValueError(f"leaf count {n} not divisible by mesh axis {d}")
+    l = n // d
+    if l & (l - 1):
+        raise ValueError(f"per-shard leaf count {l} must be a power of two")
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(None, None),
+        check_rep=False,
+    )
+    def go(block):
+        local = _local_root(block)  # [1, 8]
+        roots = jax.lax.all_gather(local, axis, axis=0, tiled=True)  # [D, 8]
+        return build_levels_device(roots)[-1]  # [1, 8], same on every shard
+
+    return jax.jit(go)(leaves)[0]
+
+
+def sharded_divergence(
+    mesh: Mesh,
+    digests: jax.Array,
+    present: jax.Array,
+    axis: str = "key",
+) -> tuple[jax.Array, jax.Array]:
+    """Keyspace-sharded multi-replica divergence.
+
+    digests: [R, N, 8] uint32; present: [R, N] bool; N divisible by the mesh
+    axis. Returns (masks [R, N] bool — sharded over keys, counts [R] int32 —
+    global via psum, replicated).
+    """
+    d = mesh.shape[axis]
+    if digests.shape[1] % d:
+        raise ValueError("key axis not divisible by mesh")
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis, None), P(None, axis)),
+        out_specs=(P(None, axis), P(None)),
+        check_rep=False,
+    )
+    def go(dig, pres):
+        masks = divergence_masks(dig, pres)
+        counts = jax.lax.psum(jnp.sum(masks, axis=1, dtype=jnp.int32), axis)
+        return masks, counts
+
+    return jax.jit(go)(digests, present)

@@ -1,19 +1,24 @@
 """Test harness configuration.
 
 JAX tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
-available in CI); the env vars must be set before jax is first imported, so
-this conftest sets them at collection time. Bench runs (bench.py) are separate
-and use the real TPU chip.
+available in CI). The environment pins jax to the tunneled TPU backend
+("axon") via a sitecustomize hook that sets the ``jax_platforms`` config
+value directly — an env-var override is ignored — so the CPU selection must
+also go through ``jax.config.update`` before any backend is initialized.
+Bench runs (bench.py) are separate and use the real TPU chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

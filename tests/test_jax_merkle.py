@@ -1,0 +1,101 @@
+"""Golden parity: JAX/TPU Merkle engine vs the CPU reference core."""
+
+import numpy as np
+import pytest
+
+from merklekv_tpu.merkle.cpu import MerkleTree
+from merklekv_tpu.merkle.jax_engine import (
+    JaxMerkleTree,
+    build_levels_jit,
+    leaf_digests,
+    tree_root,
+    tree_root_capacity,
+)
+from merklekv_tpu.ops.sha256 import digest_to_bytes, digests_to_bytes
+
+
+def _items(n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        k = f"key:{rng.integers(0, 10**9):09d}:{i}"
+        v = "v" * int(rng.integers(0, 40)) + str(i)
+        out.append((k, v))
+    return out
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 15, 16, 17, 33, 100])
+def test_root_parity_with_cpu(n):
+    items = _items(n, seed=n)
+    cpu = MerkleTree.from_items(items)
+    dev = JaxMerkleTree()
+    for k, v in items:
+        dev.insert(k, v)
+    assert dev.root_hex() == cpu.root_hex()
+
+
+def test_all_levels_parity():
+    items = _items(13, seed=42)
+    cpu = MerkleTree.from_items(items)
+    ordered = sorted((k.encode(), v.encode()) for k, v in items)
+    leaves = leaf_digests([k for k, _ in ordered], [v for _, v in ordered])
+    dev_levels = build_levels_jit(leaves)
+    cpu_levels = cpu.levels
+    assert len(dev_levels) == len(cpu_levels)
+    for dl, cl in zip(dev_levels, cpu_levels):
+        assert digests_to_bytes(np.asarray(dl)) == cl
+
+
+def test_unicode_and_nul_parity():
+    items = [("", ""), ("\x00", "\x00v"), ("héllo", "wörld"), ("世界", "值")]
+    cpu = MerkleTree.from_items(items)
+    dev = JaxMerkleTree()
+    for k, v in items:
+        dev.insert(k, v)
+    assert dev.root_hex() == cpu.root_hex()
+
+
+def test_mutation_and_removal():
+    dev = JaxMerkleTree()
+    cpu = MerkleTree()
+    for k, v in _items(20, seed=5):
+        dev.insert(k, v)
+        cpu.insert(k, v)
+    ks = sorted(dict(_items(20, seed=5)))
+    for k in ks[::3]:
+        dev.remove(k)
+        cpu.remove(k)
+    assert dev.root_hex() == cpu.root_hex()
+    dev.clear()
+    assert dev.root_hex() == "0" * 64
+    assert len(dev) == 0
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 11, 16, 29, 32])
+def test_capacity_build_matches_static(n):
+    items = _items(n, seed=100 + n)
+    ordered = sorted((k.encode(), v.encode()) for k, v in items)
+    leaves = np.asarray(
+        leaf_digests([k for k, _ in ordered], [v for _, v in ordered])
+    )
+    cap = 32
+    padded = np.zeros((cap, 8), np.uint32)
+    padded[:n] = leaves
+    got = digest_to_bytes(np.asarray(tree_root_capacity(padded, np.int32(n))))
+    want = digest_to_bytes(np.asarray(tree_root(leaves)))
+    assert got == want
+
+
+def test_capacity_rejects_non_power_of_two():
+    with pytest.raises(ValueError):
+        tree_root_capacity(np.zeros((12, 8), np.uint32), np.int32(3))
+
+
+def test_insertion_order_independence():
+    items = _items(17, seed=9)
+    a, b = JaxMerkleTree(), JaxMerkleTree()
+    for k, v in items:
+        a.insert(k, v)
+    for k, v in reversed(items):
+        b.insert(k, v)
+    assert a.root_hex() == b.root_hex()

@@ -1,0 +1,106 @@
+"""Golden tests: JAX SHA-256 vs hashlib, over the packing pipeline."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from merklekv_tpu.merkle.packing import pack_leaves
+from merklekv_tpu.ops.sha256 import (
+    digests_to_bytes,
+    sha256_blocks,
+    sha256_node_pairs,
+    sha256_single_block,
+)
+from merklekv_tpu.merkle.encoding import encode_leaf, leaf_hash, node_hash
+
+
+def _ref_digest(msg: bytes) -> bytes:
+    return hashlib.sha256(msg).digest()
+
+
+def _pack_one(msg: bytes):
+    """Pad an arbitrary message into SHA-256 blocks (test-local helper)."""
+    mlen = len(msg)
+    nb = (mlen + 9 + 63) // 64
+    buf = np.zeros(nb * 64, np.uint8)
+    buf[:mlen] = np.frombuffer(msg, np.uint8)
+    buf[mlen] = 0x80
+    buf[-8:] = np.frombuffer(np.array([mlen * 8], ">u8").tobytes(), np.uint8)
+    return buf.view(">u4").astype(np.uint32).reshape(1, nb, 16), np.array(
+        [nb], np.int32
+    )
+
+
+@pytest.mark.parametrize(
+    "msg",
+    [
+        b"",
+        b"abc",
+        b"a" * 55,  # max single-block payload
+        b"a" * 56,  # first length that spills to two blocks
+        b"a" * 63,
+        b"a" * 64,
+        b"a" * 119,
+        b"a" * 120,
+        b"hello world" * 30,  # 330 bytes, 6 blocks
+        bytes(range(256)),
+    ],
+)
+def test_sha256_blocks_matches_hashlib(msg):
+    blocks, nb = _pack_one(msg)
+    got = digests_to_bytes(sha256_blocks(blocks, nb))[0]
+    assert got == _ref_digest(msg)
+
+
+def test_sha256_single_block():
+    msg = b"abc"
+    blocks, _ = _pack_one(msg)
+    got = digests_to_bytes(sha256_single_block(blocks[:, 0, :]))[0]
+    assert got == _ref_digest(msg)
+
+
+def test_mixed_length_batch():
+    rng = np.random.default_rng(7)
+    msgs = [rng.bytes(int(n)) for n in rng.integers(0, 200, size=64)]
+    max_b = max((len(m) + 9 + 63) // 64 for m in msgs)
+    blocks = np.zeros((len(msgs), max_b, 16), np.uint32)
+    nbs = np.zeros(len(msgs), np.int32)
+    for i, m in enumerate(msgs):
+        b, nb = _pack_one(m)
+        blocks[i, : b.shape[1]] = b[0]
+        nbs[i] = nb[0]
+    got = digests_to_bytes(sha256_blocks(blocks, nbs))
+    for g, m in zip(got, msgs):
+        assert g == _ref_digest(m)
+
+
+def test_node_pairs_matches_cpu_spec():
+    rng = np.random.default_rng(3)
+    lefts = [rng.bytes(32) for _ in range(17)]
+    rights = [rng.bytes(32) for _ in range(17)]
+    l = np.stack([np.frombuffer(b, ">u4").astype(np.uint32) for b in lefts])
+    r = np.stack([np.frombuffer(b, ">u4").astype(np.uint32) for b in rights])
+    got = digests_to_bytes(sha256_node_pairs(l, r))
+    for g, lb, rb in zip(got, lefts, rights):
+        assert g == node_hash(lb, rb)
+
+
+def test_pack_leaves_matches_encode_leaf():
+    rng = np.random.default_rng(11)
+    keys, values = [], []
+    for n in range(40):
+        keys.append(rng.bytes(int(rng.integers(0, 80))))
+        values.append(rng.bytes(int(rng.integers(0, 150))))
+    keys += [b"", "héllo\x00".encode(), b"k"]
+    values += [b"", b"v", "é世界".encode()]
+    packed = pack_leaves(keys, values)
+    got = digests_to_bytes(sha256_blocks(packed.blocks, packed.nblocks))
+    for g, k, v in zip(got, keys, values):
+        assert g == _ref_digest(encode_leaf(k, v))
+        assert g == leaf_hash(k, v)
+
+
+def test_pack_leaves_empty():
+    packed = pack_leaves([], [])
+    assert packed.n == 0

@@ -1,0 +1,94 @@
+"""SPMD Merkle build + diff over the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from merklekv_tpu.merkle.cpu import MerkleTree
+from merklekv_tpu.merkle.diff import (
+    align_replicas,
+    diff_keys_multi,
+    diff_keys_pair,
+    divergence_masks,
+)
+from merklekv_tpu.merkle.encoding import leaf_hash
+from merklekv_tpu.merkle.jax_engine import leaf_digests
+from merklekv_tpu.ops.sha256 import digest_to_bytes
+from merklekv_tpu.parallel import make_mesh, sharded_divergence, sharded_tree_root
+
+
+def _leafmap(items):
+    return {k.encode(): leaf_hash(k, v) for k, v in items}
+
+
+def _items(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(f"k{rng.integers(0, 10**9):09d}:{i}", f"v{i}") for i in range(n)]
+
+
+@pytest.mark.parametrize("n_dev,per_shard", [(8, 4), (8, 16), (4, 8), (2, 32)])
+def test_sharded_root_matches_cpu(n_dev, per_shard):
+    n = n_dev * per_shard
+    items = _items(n, seed=n)
+    cpu_root = MerkleTree.from_items(items).root_hash()
+
+    ordered = sorted((k.encode(), v.encode()) for k, v in items)
+    leaves = leaf_digests([k for k, _ in ordered], [v for _, v in ordered])
+    mesh = make_mesh({"key": n_dev}, devices=jax.devices()[:n_dev])
+    got = digest_to_bytes(np.asarray(sharded_tree_root(mesh, leaves)))
+    assert got == cpu_root
+
+
+def test_sharded_root_rejects_bad_shapes():
+    mesh = make_mesh({"key": 4}, devices=jax.devices()[:4])
+    with pytest.raises(ValueError):
+        sharded_tree_root(mesh, np.zeros((10, 8), np.uint32))  # not divisible
+    with pytest.raises(ValueError):
+        sharded_tree_root(mesh, np.zeros((12, 8), np.uint32))  # L=3 not pow2
+
+
+def test_divergence_masks_basic():
+    a = _leafmap([("x", "1"), ("y", "2"), ("z", "3")])
+    b = _leafmap([("x", "1"), ("y", "CHANGED"), ("w", "4")])
+    aligned = align_replicas([a, b])
+    diffs = diff_keys_multi(aligned)
+    assert diffs[1] == [b"w", b"y", b"z"]
+    assert diff_keys_pair(a, b) == [b"w", b"y", b"z"]
+    # parity with the CPU tree's flat diff
+    ta = MerkleTree.from_items([("x", "1"), ("y", "2"), ("z", "3")])
+    tb = MerkleTree.from_items([("x", "1"), ("y", "CHANGED"), ("w", "4")])
+    assert [k.decode() for k in diff_keys_pair(a, b)] == ta.diff_keys(tb)
+
+
+def test_divergence_eight_replicas():
+    base = _items(24, seed=3)
+    replicas = []
+    for r in range(8):
+        items = dict(base)
+        if r:
+            items[f"extra{r}"] = "x"          # replica-only key
+            items[base[r][0]] = "mutated"     # changed value
+        replicas.append(_leafmap(items.items()))
+    aligned = align_replicas(replicas)
+    diffs = diff_keys_multi(aligned)
+    for r in range(1, 8):
+        assert set(diffs[r]) == {f"extra{r}".encode(), base[r][0].encode()}
+
+
+def test_sharded_divergence_matches_local():
+    base = _items(32, seed=9)
+    replicas = [_leafmap(base)]
+    mutated = dict(base)
+    mutated[base[5][0]] = "zzz"
+    del mutated[base[7][0]]
+    replicas.append(_leafmap(mutated.items()))
+    aligned = align_replicas(replicas)
+
+    mesh = make_mesh({"key": 8})
+    masks, counts = sharded_divergence(mesh, aligned.digests, aligned.present)
+    local = np.asarray(divergence_masks(aligned.digests, aligned.present))
+    np.testing.assert_array_equal(np.asarray(masks), local)
+    np.testing.assert_array_equal(
+        np.asarray(counts), local.sum(axis=1).astype(np.int32)
+    )
