@@ -54,7 +54,7 @@ def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.A
         mesh=mesh,
         in_specs=P(axis, None),
         out_specs=P(None, None),
-        check_rep=False,
+        check_vma=False,
     )
     def go(block):
         local = _local_root(block)  # [1, 8]
@@ -85,7 +85,7 @@ def sharded_divergence(
         mesh=mesh,
         in_specs=(P(None, axis, None), P(None, axis)),
         out_specs=(P(None, axis), P(None)),
-        check_rep=False,
+        check_vma=False,
     )
     def go(dig, pres):
         masks = divergence_masks(dig, pres)
