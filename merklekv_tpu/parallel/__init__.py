@@ -2,8 +2,16 @@
 
 from merklekv_tpu.parallel.mesh import make_mesh
 from merklekv_tpu.parallel.sharded_merkle import (
+    make_anti_entropy_step,
+    sharded_anti_entropy_step,
     sharded_divergence,
     sharded_tree_root,
 )
 
-__all__ = ["make_mesh", "sharded_tree_root", "sharded_divergence"]
+__all__ = [
+    "make_mesh",
+    "sharded_tree_root",
+    "sharded_divergence",
+    "sharded_anti_entropy_step",
+    "make_anti_entropy_step",
+]

@@ -16,7 +16,7 @@ shard returns the global count alongside its local mask block.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -25,13 +25,24 @@ from jax import shard_map
 
 from merklekv_tpu.merkle.jax_engine import build_levels_device
 from merklekv_tpu.merkle.diff import divergence_masks
+from merklekv_tpu.ops.sha256 import sha256_blocks
 
-__all__ = ["sharded_tree_root", "sharded_divergence"]
+__all__ = ["sharded_tree_root", "sharded_divergence", "sharded_anti_entropy_step"]
 
 
 def _local_root(block: jax.Array) -> jax.Array:
     """[L, 8] -> [1, 8] subtree root (L is a power of two)."""
     return build_levels_device(block)[-1]
+
+
+def _check_shardable(n: int, d: int, what: str = "leaf count") -> int:
+    """Validate n = d * L with L a positive power of two; return L."""
+    if n % d:
+        raise ValueError(f"{what} {n} not divisible by mesh axis {d}")
+    l = n // d
+    if l == 0 or (l & (l - 1)):
+        raise ValueError(f"per-shard {what} {l} must be a positive power of two")
+    return l
 
 
 def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.Array:
@@ -41,13 +52,7 @@ def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.A
     keyspace tensor to a bucket boundary before calling). Returns [8] uint32,
     bit-identical to ``tree_root(leaves)``.
     """
-    d = mesh.shape[axis]
-    n = leaves.shape[0]
-    if n % d:
-        raise ValueError(f"leaf count {n} not divisible by mesh axis {d}")
-    l = n // d
-    if l & (l - 1):
-        raise ValueError(f"per-shard leaf count {l} must be a power of two")
+    _check_shardable(leaves.shape[0], mesh.shape[axis])
 
     @partial(
         shard_map,
@@ -93,3 +98,64 @@ def sharded_divergence(
         return masks, counts
 
     return jax.jit(go)(digests, present)
+
+
+@lru_cache(maxsize=None)
+def make_anti_entropy_step(mesh: Mesh, axis: str = "key"):
+    """One fused SPMD anti-entropy program over a keyspace-sharded mesh.
+
+    The full data-plane step of the framework (the analog of a training step):
+      1. hash every local (key, value) leaf — batched SHA-256 over the shard's
+         padded block tensor;
+      2. reduce the local leaves to one subtree root, all_gather the D subtree
+         roots over ICI, finish the tiny top tree on every shard;
+      3. compare R replicas' digest blocks elementwise and psum the global
+         per-replica divergence counts.
+
+    Replaces the reference's host-side per-key sync loop
+    (/root/reference/src/sync.rs:56-214) with one compiled XLA program.
+
+    Inputs (global shapes):
+      blocks  [N, B, 16] uint32 — padded SHA-256 blocks, keyspace-sharded
+      nblocks [N] int32         — valid block count per leaf
+      digests [R, N, 8] uint32  — R replicas' leaf digests (replicated over R)
+      present [R, N] bool
+    Returns (root [8] uint32 replicated, masks [R, N] bool sharded over keys,
+    counts [R] int32 replicated).
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis), P(None, axis, None), P(None, axis)),
+        out_specs=(P(None), P(None, axis), P(None)),
+        check_vma=False,
+    )
+    def step(blk, nb, dig, pres):
+        leaves = sha256_blocks(blk, nb)
+        local_root = _local_root(leaves)  # [1, 8]
+        roots = jax.lax.all_gather(local_root, axis, axis=0, tiled=True)  # [D, 8]
+        root = build_levels_device(roots)[-1][0]  # [8]
+        masks = divergence_masks(dig, pres)
+        counts = jax.lax.psum(jnp.sum(masks, axis=1, dtype=jnp.int32), axis)
+        return root, masks, counts
+
+    return jax.jit(step)
+
+
+def sharded_anti_entropy_step(
+    mesh: Mesh,
+    blocks: jax.Array,
+    nblocks: jax.Array,
+    digests: jax.Array,
+    present: jax.Array,
+    axis: str = "key",
+):
+    """Run the fused hash+build+diff step (see :func:`make_anti_entropy_step`)."""
+    d = mesh.shape[axis]
+    _check_shardable(blocks.shape[0], d)
+    if digests.shape[1] != blocks.shape[0]:
+        raise ValueError(
+            f"digest key axis {digests.shape[1]} != leaf count {blocks.shape[0]}"
+        )
+    return make_anti_entropy_step(mesh, axis)(blocks, nblocks, digests, present)

@@ -15,7 +15,13 @@ from merklekv_tpu.merkle.diff import (
 from merklekv_tpu.merkle.encoding import leaf_hash
 from merklekv_tpu.merkle.jax_engine import leaf_digests
 from merklekv_tpu.ops.sha256 import digest_to_bytes
-from merklekv_tpu.parallel import make_mesh, sharded_divergence, sharded_tree_root
+from merklekv_tpu.merkle.packing import pack_leaves
+from merklekv_tpu.parallel import (
+    make_mesh,
+    sharded_anti_entropy_step,
+    sharded_divergence,
+    sharded_tree_root,
+)
 
 
 def _leafmap(items):
@@ -74,6 +80,52 @@ def test_divergence_eight_replicas():
     diffs = diff_keys_multi(aligned)
     for r in range(1, 8):
         assert set(diffs[r]) == {f"extra{r}".encode(), base[r][0].encode()}
+
+
+def test_fused_anti_entropy_step_matches_cpu():
+    """The fused hash+build+diff program agrees with the CPU core end to end."""
+    n = 8 * 8
+    items = sorted((f"fk{i:04d}", f"fv{i * 3}") for i in range(n))
+    cpu_root = MerkleTree.from_items(items).root_hash()
+
+    keys = [k.encode() for k, _ in items]
+    values = [v.encode() for _, v in items]
+    packed = pack_leaves(keys, values)
+
+    local = _leafmap(items)
+    mutated = dict(items)
+    mutated[items[11][0]] = "CHANGED"
+    replicas = [local, _leafmap(mutated.items()), dict(local)]
+    aligned = align_replicas(replicas)
+
+    mesh = make_mesh({"key": 8})
+    root, masks, counts = sharded_anti_entropy_step(
+        mesh, packed.blocks, packed.nblocks, aligned.digests, aligned.present
+    )
+    assert digest_to_bytes(np.asarray(root)) == cpu_root
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.asarray([0, 1, 0], np.int32)
+    )
+    local_masks = np.asarray(divergence_masks(aligned.digests, aligned.present))
+    np.testing.assert_array_equal(np.asarray(masks), local_masks)
+
+
+def test_fused_step_rejects_bad_shapes():
+    mesh = make_mesh({"key": 4}, devices=jax.devices()[:4])
+    blocks = np.zeros((16, 1, 16), np.uint32)
+    nblocks = np.ones((16,), np.int32)
+    with pytest.raises(ValueError):  # digest axis mismatch
+        sharded_anti_entropy_step(
+            mesh, blocks, nblocks, np.zeros((2, 8, 8), np.uint32), np.zeros((2, 8), bool)
+        )
+    with pytest.raises(ValueError):  # empty keyspace
+        sharded_anti_entropy_step(
+            mesh,
+            np.zeros((0, 1, 16), np.uint32),
+            np.zeros((0,), np.int32),
+            np.zeros((2, 0, 8), np.uint32),
+            np.zeros((2, 0), bool),
+        )
 
 
 def test_sharded_divergence_matches_local():
