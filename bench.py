@@ -61,22 +61,18 @@ def bench_cpu(n: int) -> float:
 
 def bench_tpu(n: int) -> float:
     import jax
-    import jax.numpy as jnp
 
-    from merklekv_tpu.merkle.diff import divergence_masks
-    from merklekv_tpu.merkle.jax_engine import build_levels_device
+    from merklekv_tpu.merkle.jax_engine import anti_entropy_forward
     from merklekv_tpu.merkle.packing import pack_leaves
-    from merklekv_tpu.ops.sha256 import sha256_blocks
 
     keys, values = _make_kv(n)
     packed = pack_leaves(keys, values)
 
     @jax.jit
     def step(blocks, nblocks, stacked, present):
-        leaves = sha256_blocks(blocks, nblocks)
-        root = build_levels_device(leaves)[-1][0]
-        masks = divergence_masks(stacked, present)
-        counts = jnp.sum(masks, axis=1, dtype=jnp.int32)
+        root, _masks, counts = anti_entropy_forward(
+            blocks, nblocks, stacked, present
+        )
         return root, counts
 
     rng = np.random.RandomState(7)

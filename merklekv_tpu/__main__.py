@@ -1,0 +1,80 @@
+"""CLI entry point: `python -m merklekv_tpu [--config X] [--engine E] ...`.
+
+Flag surface mirrors the reference binary (/root/reference/src/main.rs:61-151):
+--config, --engine, --storage-path, plus --host/--port conveniences. Starts
+the native TCP server on a native engine; when replication or anti-entropy
+is enabled in config, the Python control plane (event publisher, sync
+manager, TPU Merkle engine) runs alongside in this process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="merklekv_tpu")
+    p.add_argument("--config", help="TOML config file")
+    p.add_argument("--engine", help="storage engine: mem|rwlock|kv|log|sled")
+    p.add_argument("--storage-path", help="data dir for the durable engine")
+    p.add_argument("--host")
+    p.add_argument("--port", type=int)
+    args = p.parse_args(argv)
+
+    from merklekv_tpu.config import load_or_default
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+    from merklekv_tpu.version import __version__
+
+    cfg = load_or_default(args.config)
+    if args.engine:
+        cfg.engine = args.engine
+    if args.storage_path:
+        cfg.storage_path = args.storage_path
+    if args.host:
+        cfg.host = args.host
+    if args.port is not None:
+        cfg.port = args.port
+
+    engine = NativeEngine(cfg.engine, cfg.storage_path)
+    server = NativeServer(
+        engine, cfg.host, cfg.port, version=__version__, exit_on_shutdown=False
+    )
+    server.start()
+    print(
+        f"merklekv_tpu listening on {cfg.host}:{server.port} "
+        f"(engine={cfg.engine})",
+        flush=True,
+    )
+
+    node = None
+    if cfg.replication.enabled or cfg.anti_entropy.enabled:
+        from merklekv_tpu.cluster.node import ClusterNode
+
+        node = ClusterNode(cfg, engine, server)
+        node.start()
+
+    stop = {"flag": False}
+
+    def on_signal(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    try:
+        while not stop["flag"] and not server.stopping:
+            time.sleep(0.1)
+    finally:
+        if node is not None:
+            node.stop()
+        server.close()
+        engine.sync()
+        engine.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,446 @@
+"""Python client SDK for the merklekv_tpu text protocol.
+
+First-class client covering the full command surface (the reference ships 13
+language SDKs over the same wire format, clients/IMPLEMENTATION_SUMMARY.md;
+this is the canonical one — see docs/PROTOCOL.md for the wire spec other
+languages can implement). Sync (`MerkleKVClient`) and asyncio
+(`AsyncMerkleKVClient`) variants share response parsing.
+
+Conventions (matching the reference SDKs): TCP_NODELAY on, default port
+7379, `MERKLEKV_PORT` env override, `VALUE ` prefixes stripped, `ERROR ...`
+responses raised as ProtocolError.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+from typing import Iterable, Optional, Sequence
+
+DEFAULT_PORT = int(os.environ.get("MERKLEKV_PORT", "7379"))
+
+
+class MerkleKVError(Exception):
+    """Base error."""
+
+
+class ConnectionError(MerkleKVError):  # noqa: A001 - parity with reference SDK
+    """Connection failed or not connected."""
+
+
+class ProtocolError(MerkleKVError):
+    """Server returned ERROR or an unexpected response."""
+
+
+# --------------------------------------------------------------- parsing
+
+def _parse_simple(resp: str) -> str:
+    if resp.startswith("ERROR "):
+        raise ProtocolError(resp[6:])
+    return resp
+
+
+def _parse_value(resp: str) -> Optional[str]:
+    resp = _parse_simple(resp)
+    if resp == "NOT_FOUND":
+        return None
+    if resp.startswith("VALUE "):
+        return resp[6:]
+    raise ProtocolError(f"unexpected response: {resp}")
+
+
+def _count_after(resp: str, prefix: str) -> int:
+    resp = _parse_simple(resp)
+    if not resp.startswith(prefix):
+        raise ProtocolError(f"unexpected response: {resp}")
+    return int(resp[len(prefix):])
+
+
+class _ResponseReader:
+    """Incremental CRLF line splitter over a byte stream."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, data: bytes) -> None:
+        self._buf += data
+
+    def next_line(self) -> Optional[str]:
+        i = self._buf.find(b"\n")
+        if i < 0:
+            return None
+        line = self._buf[: i + 1]
+        self._buf = self._buf[i + 1 :]
+        return line.rstrip(b"\r\n").decode("utf-8", "surrogateescape")
+
+
+class MerkleKVClient:
+    """Synchronous client. Context-manager friendly:
+
+        with MerkleKVClient("localhost", 7379) as c:
+            c.set("k", "v")
+            assert c.get("k") == "v"
+    """
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = DEFAULT_PORT,
+        timeout: float = 5.0,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._reader = _ResponseReader()
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect(self) -> "MerkleKVClient":
+        try:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout
+            )
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError as e:
+            raise ConnectionError(
+                f"failed to connect to {self.host}:{self.port}: {e}"
+            ) from e
+        return self
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def is_connected(self) -> bool:
+        return self._sock is not None
+
+    def __enter__(self) -> "MerkleKVClient":
+        if self._sock is None:
+            self.connect()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- wire --------------------------------------------------------------
+    def _send_line(self, line: str) -> None:
+        if self._sock is None:
+            raise ConnectionError("not connected; call connect() first")
+        try:
+            self._sock.sendall(line.encode("utf-8") + b"\r\n")
+        except OSError as e:
+            raise ConnectionError(f"send failed: {e}") from e
+
+    def _read_line(self) -> str:
+        while True:
+            line = self._reader.next_line()
+            if line is not None:
+                return line
+            try:
+                data = self._sock.recv(65536)
+            except socket.timeout as e:
+                raise MerkleKVError(f"timed out after {self.timeout}s") from e
+            except OSError as e:
+                raise ConnectionError(f"recv failed: {e}") from e
+            if not data:
+                raise ConnectionError("server closed connection")
+            self._reader.feed(data)
+
+    def _request(self, line: str) -> str:
+        self._send_line(line)
+        return self._read_line()
+
+    def _read_body(self, n: int) -> list[str]:
+        return [self._read_line() for _ in range(n)]
+
+    # -- basic -------------------------------------------------------------
+    def get(self, key: str) -> Optional[str]:
+        return _parse_value(self._request(f"GET {key}"))
+
+    def set(self, key: str, value: str) -> bool:
+        resp = _parse_simple(self._request(f"SET {key} {value}"))
+        if resp != "OK":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return True
+
+    def delete(self, key: str) -> bool:
+        resp = _parse_simple(self._request(f"DELETE {key}"))
+        if resp == "DELETED":
+            return True
+        if resp == "NOT_FOUND":
+            return False
+        raise ProtocolError(f"unexpected response: {resp}")
+
+    # -- numeric / string ----------------------------------------------------
+    def increment(self, key: str, amount: Optional[int] = None) -> int:
+        cmd = f"INC {key}" if amount is None else f"INC {key} {amount}"
+        return int(_parse_value(self._request(cmd)))
+
+    def decrement(self, key: str, amount: Optional[int] = None) -> int:
+        cmd = f"DEC {key}" if amount is None else f"DEC {key} {amount}"
+        return int(_parse_value(self._request(cmd)))
+
+    def append(self, key: str, value: str) -> str:
+        return _parse_value(self._request(f"APPEND {key} {value}"))
+
+    def prepend(self, key: str, value: str) -> str:
+        return _parse_value(self._request(f"PREPEND {key} {value}"))
+
+    # -- bulk ----------------------------------------------------------------
+    def mget(self, keys: Sequence[str]) -> dict[str, Optional[str]]:
+        resp = self._request("MGET " + " ".join(keys))
+        resp = _parse_simple(resp)
+        out: dict[str, Optional[str]] = {k: None for k in keys}
+        if resp == "NOT_FOUND":
+            # Server still sent one line per key? No: bare NOT_FOUND only.
+            return out
+        if not resp.startswith("VALUES "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        for _ in range(len(keys)):
+            line = self._read_line()
+            k, _, v = line.partition(" ")
+            out[k] = None if v == "NOT_FOUND" else v
+        return out
+
+    def mset(self, pairs: dict[str, str]) -> bool:
+        parts = []
+        for k, v in pairs.items():
+            parts += [k, v]
+        resp = _parse_simple(self._request("MSET " + " ".join(parts)))
+        if resp != "OK":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return True
+
+    def truncate(self) -> bool:
+        return _parse_simple(self._request("TRUNCATE")) == "OK"
+
+    # -- query ---------------------------------------------------------------
+    def exists(self, *keys: str) -> int:
+        return _count_after(self._request("EXISTS " + " ".join(keys)), "EXISTS ")
+
+    def scan(self, prefix: str = "") -> list[str]:
+        cmd = f"SCAN {prefix}" if prefix else "SCAN"
+        n = _count_after(self._request(cmd), "KEYS ")
+        return self._read_body(n)
+
+    def dbsize(self) -> int:
+        return _count_after(self._request("DBSIZE"), "DBSIZE ")
+
+    def hash(self, pattern: Optional[str] = None) -> str:
+        cmd = "HASH" if pattern is None else f"HASH {pattern}"
+        resp = _parse_simple(self._request(cmd))
+        if not resp.startswith("HASH "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return resp.rsplit(" ", 1)[-1]
+
+    # -- admin ---------------------------------------------------------------
+    def ping(self, message: str = "") -> str:
+        cmd = f"PING {message}" if message else "PING"
+        return _parse_simple(self._request(cmd))
+
+    def echo(self, message: str) -> str:
+        resp = _parse_simple(self._request(f"ECHO {message}"))
+        if not resp.startswith("ECHO "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return resp[5:]
+
+    def health_check(self) -> bool:
+        try:
+            return self.ping().startswith("PONG")
+        except MerkleKVError:
+            return False
+
+    def stats(self) -> dict[str, str]:
+        resp = _parse_simple(self._request("STATS"))
+        if resp != "STATS":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_kv_block()
+
+    def info(self) -> dict[str, str]:
+        resp = _parse_simple(self._request("INFO"))
+        if resp != "INFO":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_kv_block()
+
+    def _read_kv_block(self) -> dict[str, str]:
+        # Stats/info blocks have no terminator; they are a fixed set of
+        # `name:value` lines. Read until the buffered stream drains: issue a
+        # PING sentinel to delimit.
+        self._send_line("PING __end__")
+        out: dict[str, str] = {}
+        while True:
+            line = self._read_line()
+            if line == "PONG __end__":
+                return out
+            name, _, value = line.partition(":")
+            out[name] = value
+
+    def version(self) -> str:
+        resp = _parse_simple(self._request("VERSION"))
+        if not resp.startswith("VERSION "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return resp[8:]
+
+    def memory(self) -> int:
+        return _count_after(self._request("MEMORY"), "MEMORY ")
+
+    def client_list(self) -> list[dict[str, str]]:
+        resp = _parse_simple(self._request("CLIENT LIST"))
+        if resp != "CLIENT LIST":
+            raise ProtocolError(f"unexpected response: {resp}")
+        rows = []
+        while True:
+            line = self._read_line()
+            if line == "END":
+                return rows
+            rows.append(dict(f.split("=", 1) for f in line.split(" ") if "=" in f))
+
+    def flushdb(self) -> bool:
+        return _parse_simple(self._request("FLUSHDB")) == "OK"
+
+    def shutdown(self) -> None:
+        try:
+            self._request("SHUTDOWN")
+        except ConnectionError:
+            pass
+
+    # -- cluster -------------------------------------------------------------
+    def sync_with(self, host: str, port: int, full: bool = False,
+                  verify: bool = False) -> bool:
+        cmd = f"SYNC {host} {port}"
+        if full:
+            cmd += " --full"
+        if verify:
+            cmd += " --verify"
+        return _parse_simple(self._request(cmd)) == "OK"
+
+    def replicate(self, action: str) -> str:
+        return _parse_simple(self._request(f"REPLICATE {action}"))
+
+    # -- pipeline ------------------------------------------------------------
+    def pipeline(self, commands: Iterable[str]) -> list[str]:
+        """Send raw command lines back-to-back, collect one response line per
+        command (only valid for single-line-response commands)."""
+        cmds = list(commands)
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        payload = "".join(c + "\r\n" for c in cmds).encode("utf-8")
+        self._sock.sendall(payload)
+        return [self._read_line() for _ in cmds]
+
+
+class AsyncMerkleKVClient:
+    """asyncio variant with the same core surface."""
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = DEFAULT_PORT,
+        timeout: float = 5.0,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self) -> "AsyncMerkleKVClient":
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectionError(
+                f"failed to connect to {self.host}:{self.port}: {e}"
+            ) from e
+        sock = self._writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except OSError:
+                pass
+            self._reader = self._writer = None
+
+    async def __aenter__(self) -> "AsyncMerkleKVClient":
+        if self._writer is None:
+            await self.connect()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def _request(self, line: str) -> str:
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        self._writer.write(line.encode("utf-8") + b"\r\n")
+        await self._writer.drain()
+        return await self._read_line()
+
+    async def _read_line(self) -> str:
+        raw = await asyncio.wait_for(self._reader.readline(), self.timeout)
+        if not raw:
+            raise ConnectionError("server closed connection")
+        return raw.rstrip(b"\r\n").decode("utf-8", "surrogateescape")
+
+    async def get(self, key: str) -> Optional[str]:
+        return _parse_value(await self._request(f"GET {key}"))
+
+    async def set(self, key: str, value: str) -> bool:
+        resp = _parse_simple(await self._request(f"SET {key} {value}"))
+        if resp != "OK":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return True
+
+    async def delete(self, key: str) -> bool:
+        resp = _parse_simple(await self._request(f"DELETE {key}"))
+        if resp == "DELETED":
+            return True
+        if resp == "NOT_FOUND":
+            return False
+        raise ProtocolError(f"unexpected response: {resp}")
+
+    async def increment(self, key: str, amount: Optional[int] = None) -> int:
+        cmd = f"INC {key}" if amount is None else f"INC {key} {amount}"
+        return int(_parse_value(await self._request(cmd)))
+
+    async def scan(self, prefix: str = "") -> list[str]:
+        cmd = f"SCAN {prefix}" if prefix else "SCAN"
+        resp = _parse_simple(await self._request(cmd))
+        if not resp.startswith("KEYS "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return [await self._read_line() for _ in range(int(resp[5:]))]
+
+    async def hash(self, pattern: Optional[str] = None) -> str:
+        cmd = "HASH" if pattern is None else f"HASH {pattern}"
+        resp = _parse_simple(await self._request(cmd))
+        if not resp.startswith("HASH "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return resp.rsplit(" ", 1)[-1]
+
+    async def ping(self, message: str = "") -> str:
+        cmd = f"PING {message}" if message else "PING"
+        return _parse_simple(await self._request(cmd))
+
+    async def health_check(self) -> bool:
+        try:
+            return (await self.ping()).startswith("PONG")
+        except (MerkleKVError, asyncio.TimeoutError):
+            return False
+
+    async def pipeline(self, commands: Iterable[str]) -> list[str]:
+        cmds = list(commands)
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        self._writer.write("".join(c + "\r\n" for c in cmds).encode("utf-8"))
+        await self._writer.drain()
+        return [await self._read_line() for _ in cmds]

@@ -1,0 +1,101 @@
+"""Configuration: TOML file + CLI/env overrides.
+
+Schema mirrors the reference's Config (/root/reference/src/config.rs:48-109):
+top-level host/port/storage_path/engine/sync_interval_seconds, a
+[replication] table, and an [anti_entropy] table. Secrets come env-first
+(CLIENT_ID / CLIENT_PASSWORD, reference replication.rs:101-112). Parsing
+uses stdlib tomllib — no third-party config crate needed.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ReplicationConfig:
+    enabled: bool = False
+    # MQTT-style broker endpoint for WAN replication; "local" selects the
+    # in-process event bus (tests / single-host clusters).
+    mqtt_broker: str = "localhost"
+    mqtt_port: int = 1883
+    topic_prefix: str = "merkle_kv"
+    client_id: str = ""
+    username: str = ""
+    password: str = ""
+    peer_list: list[str] = field(default_factory=list)
+
+    def resolve_env(self) -> None:
+        self.client_id = os.environ.get("CLIENT_ID", self.client_id)
+        self.password = os.environ.get("CLIENT_PASSWORD", self.password)
+
+
+@dataclass
+class AntiEntropyConfig:
+    enabled: bool = False
+    interval_seconds: float = 60.0
+    peers: list[str] = field(default_factory=list)  # "host:port"
+    # "cpu" forces the host diff path; "auto" uses the TPU engine when the
+    # keyspace is large enough to amortize a device round-trip.
+    engine: str = "auto"
+
+
+@dataclass
+class Config:
+    host: str = "127.0.0.1"
+    port: int = 7379
+    storage_path: str = "merklekv_data"
+    engine: str = "mem"
+    sync_interval_seconds: float = 60.0
+    replication: ReplicationConfig = field(default_factory=ReplicationConfig)
+    anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Config":
+        cfg = cls()
+        for k in ("host", "storage_path", "engine"):
+            if k in raw:
+                setattr(cfg, k, str(raw[k]))
+        if "port" in raw:
+            cfg.port = int(raw["port"])
+        if "sync_interval_seconds" in raw:
+            cfg.sync_interval_seconds = float(raw["sync_interval_seconds"])
+        rep = raw.get("replication", {})
+        for k in ("mqtt_broker", "topic_prefix", "client_id", "username",
+                  "password"):
+            if k in rep:
+                setattr(cfg.replication, k, str(rep[k]))
+        if "enabled" in rep:
+            cfg.replication.enabled = bool(rep["enabled"])
+        if "mqtt_port" in rep:
+            cfg.replication.mqtt_port = int(rep["mqtt_port"])
+        if "peer_list" in rep:
+            cfg.replication.peer_list = [str(p) for p in rep["peer_list"]]
+        ae = raw.get("anti_entropy", {})
+        if "enabled" in ae:
+            cfg.anti_entropy.enabled = bool(ae["enabled"])
+        if "interval_seconds" in ae:
+            cfg.anti_entropy.interval_seconds = float(ae["interval_seconds"])
+        if "peers" in ae:
+            cfg.anti_entropy.peers = [str(p) for p in ae["peers"]]
+        if "engine" in ae:
+            cfg.anti_entropy.engine = str(ae["engine"])
+        cfg.replication.resolve_env()
+        return cfg
+
+
+def load_or_default(path: Optional[str]) -> Config:
+    if path:
+        return Config.load(path)
+    cfg = Config()
+    cfg.replication.resolve_env()
+    return cfg

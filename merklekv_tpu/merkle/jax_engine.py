@@ -42,8 +42,28 @@ __all__ = [
     "build_levels_device",
     "tree_root",
     "tree_root_capacity",
+    "anti_entropy_forward",
     "JaxMerkleTree",
 ]
+
+
+def anti_entropy_forward(blocks, nblocks, digests, present):
+    """The canonical single-chip data-plane step: hash every leaf, reduce to
+    the tree root, and compute R-replica divergence — one jittable program.
+
+    Shared by ``bench.py``, ``__graft_entry__.entry()``, and the sync
+    manager so they all measure/compile the same forward program.
+
+    blocks [N, B, 16] u32, nblocks [N] i32, digests [R, N, 8] u32,
+    present [R, N] bool -> (root [8] u32, masks [R, N] bool, counts [R] i32).
+    """
+    from merklekv_tpu.merkle.diff import divergence_masks
+
+    leaves = sha256_blocks(blocks, nblocks)
+    root = build_levels_device(leaves)[-1][0]
+    masks = divergence_masks(digests, present)
+    counts = jnp.sum(masks, axis=1, dtype=jnp.int32)
+    return root, masks, counts
 
 
 # ------------------------------------------------------------ leaf hashing

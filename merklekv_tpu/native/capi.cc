@@ -1,0 +1,302 @@
+// C ABI for the Python control plane (ctypes).
+//
+// The Python side (merklekv_tpu/native_bindings.py) drives engines and the
+// server through these handles; buffers returned through out-params are
+// malloc'd here and released with mkv_free. Serialization formats are
+// little-endian length-prefixed, documented per function.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "events.h"
+#include "merkle.h"
+#include "server.h"
+
+using mkv::Engine;
+using mkv::Server;
+
+namespace {
+
+char* dup_buffer(const std::string& s) {
+  char* p = static_cast<char*>(std::malloc(s.size() ? s.size() : 1));
+  if (p && !s.empty()) std::memcpy(p, s.data(), s.size());
+  return p;
+}
+
+void put_u32(std::string& out, uint32_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 4);
+}
+void put_u64(std::string& out, uint64_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 8);
+}
+
+struct ServerHandle {
+  Server* server;
+  // Keeps the ctypes callback trampoline alive via Python; C++ only stores
+  // the raw pointer + context.
+  void* cb_ctx = nullptr;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- memory
+
+void mkv_free(void* p) { std::free(p); }
+
+// ---------------------------------------------------------------- engine
+
+void* mkv_engine_create(const char* kind, const char* path) {
+  auto eng = mkv::make_engine(kind ? kind : "mem", path ? path : "");
+  return eng.release();
+}
+
+void mkv_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+// Returns 1 if found (out/out_len set; free with mkv_free), 0 otherwise.
+int mkv_engine_get(void* h, const char* key, int klen, char** out,
+                   int* out_len) {
+  auto v = static_cast<Engine*>(h)->get(std::string(key, size_t(klen)));
+  if (!v) return 0;
+  *out = dup_buffer(*v);
+  *out_len = int(v->size());
+  return 1;
+}
+
+int mkv_engine_set(void* h, const char* key, int klen, const char* val,
+                   int vlen) {
+  return static_cast<Engine*>(h)->set(std::string(key, size_t(klen)),
+                                      std::string(val, size_t(vlen)))
+             ? 1
+             : 0;
+}
+
+int mkv_engine_del(void* h, const char* key, int klen) {
+  return static_cast<Engine*>(h)->del(std::string(key, size_t(klen))) ? 1 : 0;
+}
+
+int mkv_engine_exists(void* h, const char* key, int klen) {
+  return static_cast<Engine*>(h)->exists(std::string(key, size_t(klen))) ? 1
+                                                                          : 0;
+}
+
+long long mkv_engine_dbsize(void* h) {
+  return (long long)static_cast<Engine*>(h)->dbsize();
+}
+
+long long mkv_engine_memory_usage(void* h) {
+  return (long long)static_cast<Engine*>(h)->memory_usage();
+}
+
+int mkv_engine_truncate(void* h) {
+  return static_cast<Engine*>(h)->truncate() ? 1 : 0;
+}
+
+int mkv_engine_sync(void* h) {
+  return static_cast<Engine*>(h)->sync() ? 1 : 0;
+}
+
+// increment/decrement: returns 1 on success with *out_value set; on error
+// returns 0 and fills err/err_len (free with mkv_free).
+int mkv_engine_increment(void* h, const char* key, int klen, long long amount,
+                         long long* out_value, char** err, int* err_len) {
+  auto r = static_cast<Engine*>(h)->increment(std::string(key, size_t(klen)),
+                                              int64_t(amount));
+  if (r.ok) {
+    *out_value = r.value;
+    return 1;
+  }
+  *err = dup_buffer(r.error);
+  *err_len = int(r.error.size());
+  return 0;
+}
+
+int mkv_engine_decrement(void* h, const char* key, int klen, long long amount,
+                         long long* out_value, char** err, int* err_len) {
+  auto r = static_cast<Engine*>(h)->decrement(std::string(key, size_t(klen)),
+                                              int64_t(amount));
+  if (r.ok) {
+    *out_value = r.value;
+    return 1;
+  }
+  *err = dup_buffer(r.error);
+  *err_len = int(r.error.size());
+  return 0;
+}
+
+// append/prepend: returns 1 with *out/*out_len = new value, else 0 with err.
+int mkv_engine_append(void* h, const char* key, int klen, const char* val,
+                      int vlen, char** out, int* out_len, char** err,
+                      int* err_len) {
+  auto r = static_cast<Engine*>(h)->append(std::string(key, size_t(klen)),
+                                           std::string(val, size_t(vlen)));
+  if (r.ok) {
+    *out = dup_buffer(r.value);
+    *out_len = int(r.value.size());
+    return 1;
+  }
+  *err = dup_buffer(r.error);
+  *err_len = int(r.error.size());
+  return 0;
+}
+
+int mkv_engine_prepend(void* h, const char* key, int klen, const char* val,
+                       int vlen, char** out, int* out_len, char** err,
+                       int* err_len) {
+  auto r = static_cast<Engine*>(h)->prepend(std::string(key, size_t(klen)),
+                                            std::string(val, size_t(vlen)));
+  if (r.ok) {
+    *out = dup_buffer(r.value);
+    *out_len = int(r.value.size());
+    return 1;
+  }
+  *err = dup_buffer(r.error);
+  *err_len = int(r.error.size());
+  return 0;
+}
+
+// scan: newline-safe serialization — u32 count, then per key u32 len + bytes.
+int mkv_engine_scan(void* h, const char* prefix, int plen, char** out,
+                    int* out_len) {
+  auto keys =
+      static_cast<Engine*>(h)->scan(std::string(prefix, size_t(plen)));
+  std::string buf;
+  put_u32(buf, uint32_t(keys.size()));
+  for (const auto& k : keys) {
+    put_u32(buf, uint32_t(k.size()));
+    buf += k;
+  }
+  *out = dup_buffer(buf);
+  *out_len = int(buf.size());
+  return 1;
+}
+
+// snapshot: u32 count, then per item u32 klen + key + u32 vlen + value,
+// sorted by key. This is the TPU rebuild input.
+int mkv_engine_snapshot(void* h, char** out, long long* out_len) {
+  auto snap = static_cast<Engine*>(h)->snapshot();
+  std::string buf;
+  put_u32(buf, uint32_t(snap.size()));
+  for (const auto& [k, v] : snap) {
+    put_u32(buf, uint32_t(k.size()));
+    buf += k;
+    put_u32(buf, uint32_t(v.size()));
+    buf += v;
+  }
+  char* p = static_cast<char*>(std::malloc(buf.size() ? buf.size() : 1));
+  if (p && !buf.empty()) std::memcpy(p, buf.data(), buf.size());
+  *out = p;
+  *out_len = (long long)buf.size();
+  return 1;
+}
+
+// Merkle root over the current snapshot, written to out32 (32 bytes).
+// Returns 0 for an empty keyspace.
+int mkv_engine_merkle_root(void* h, unsigned char* out32) {
+  auto snap = static_cast<Engine*>(h)->snapshot();
+  return mkv::merkle_root(std::move(snap), out32) ? 1 : 0;
+}
+
+// ---------------------------------------------------------------- server
+
+// Cluster callback ABI: cb(ctx, line, out_buf, out_cap) -> response length
+// written into out_buf, or <= 0 for "unhandled".
+typedef int (*mkv_cluster_cb)(void* ctx, const char* line, char* out_buf,
+                              int out_cap);
+
+void* mkv_server_create(void* engine, const char* host, int port,
+                        const char* version, int exit_on_shutdown) {
+  mkv::ServerOptions opts;
+  opts.host = host ? host : "127.0.0.1";
+  opts.port = uint16_t(port);
+  opts.version = version ? version : "0.1.0";
+  opts.exit_on_shutdown = exit_on_shutdown != 0;
+  auto* hs = new ServerHandle{
+      new Server(static_cast<Engine*>(engine), std::move(opts))};
+  return hs;
+}
+
+int mkv_server_start(void* h) {
+  return static_cast<ServerHandle*>(h)->server->start() ? 1 : 0;
+}
+
+int mkv_server_port(void* h) {
+  return static_cast<ServerHandle*>(h)->server->port();
+}
+
+int mkv_server_stopping(void* h) {
+  return static_cast<ServerHandle*>(h)->server->stopping() ? 1 : 0;
+}
+
+void mkv_server_stop(void* h) {
+  static_cast<ServerHandle*>(h)->server->stop();
+}
+
+void mkv_server_wait(void* h) {
+  static_cast<ServerHandle*>(h)->server->wait();
+}
+
+void mkv_server_destroy(void* h) {
+  auto* hs = static_cast<ServerHandle*>(h);
+  hs->server->stop();
+  hs->server->wait();
+  delete hs->server;
+  delete hs;
+}
+
+void mkv_server_set_cluster_cb(void* h, mkv_cluster_cb cb, void* ctx) {
+  auto* hs = static_cast<ServerHandle*>(h);
+  if (!cb) {
+    hs->server->set_cluster_callback(nullptr);
+    return;
+  }
+  hs->server->set_cluster_callback([cb, ctx](const std::string& line) {
+    std::vector<char> buf(64 * 1024);
+    int n = cb(ctx, line.c_str(), buf.data(), int(buf.size()));
+    if (n <= 0) return std::string();
+    return std::string(buf.data(), size_t(std::min(n, int(buf.size()))));
+  });
+}
+
+// Drain up to max_events change events. Serialization per event: u8 op,
+// u8 has_value, u64 ts_ns, u64 seq, u32 klen, key, u32 vlen, value; prefixed
+// with u32 count. Free with mkv_free.
+int mkv_server_drain_events(void* h, int max_events, char** out,
+                            long long* out_len) {
+  auto evs = static_cast<ServerHandle*>(h)->server->events().drain(
+      max_events < 0 ? 0 : size_t(max_events));
+  std::string buf;
+  put_u32(buf, uint32_t(evs.size()));
+  for (const auto& e : evs) {
+    buf.push_back(char(uint8_t(e.op)));
+    buf.push_back(char(e.has_value ? 1 : 0));
+    put_u64(buf, e.ts_ns);
+    put_u64(buf, e.seq);
+    put_u32(buf, uint32_t(e.key.size()));
+    buf += e.key;
+    put_u32(buf, uint32_t(e.value.size()));
+    buf += e.value;
+  }
+  char* p = static_cast<char*>(std::malloc(buf.size() ? buf.size() : 1));
+  if (p && !buf.empty()) std::memcpy(p, buf.data(), buf.size());
+  *out = p;
+  *out_len = (long long)buf.size();
+  return 1;
+}
+
+long long mkv_server_events_dropped(void* h) {
+  return (long long)static_cast<ServerHandle*>(h)->server->events().dropped();
+}
+
+// Stats text exactly as the STATS command body (for the control plane).
+int mkv_server_stats(void* h, char** out, int* out_len) {
+  std::string s = static_cast<ServerHandle*>(h)->server->stats().format_stats();
+  *out = dup_buffer(s);
+  *out_len = int(s.size());
+  return 1;
+}
+
+}  // extern "C"

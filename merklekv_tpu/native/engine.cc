@@ -1,0 +1,384 @@
+#include "engine.h"
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <mutex>
+
+namespace mkv {
+
+namespace {
+
+// Full-string i64 parse with Rust `str::parse::<i64>` semantics: optional
+// +/-, decimal digits only, no whitespace, overflow is an error.
+bool parse_i64(const std::string& s, int64_t* out) {
+  if (s.empty()) return false;
+  size_t i = 0;
+  bool neg = false;
+  if (s[0] == '+' || s[0] == '-') {
+    neg = s[0] == '-';
+    i = 1;
+    if (s.size() == 1) return false;
+  }
+  uint64_t acc = 0;
+  const uint64_t limit =
+      neg ? (uint64_t(1) << 63) : (uint64_t(1) << 63) - 1;
+  for (; i < s.size(); ++i) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    uint64_t d = uint64_t(s[i] - '0');
+    if (acc > (limit - d) / 10) return false;
+    acc = acc * 10 + d;
+  }
+  *out = neg ? -int64_t(acc) : int64_t(acc);
+  return true;
+}
+
+std::string not_a_number(const std::string& key) {
+  return "Value for key '" + key + "' is not a valid number";
+}
+
+}  // namespace
+
+// ------------------------------------------------------------- MemEngine
+
+MemEngine::Shard& MemEngine::shard_for(const std::string& key) {
+  return shards_[std::hash<std::string>{}(key) % kShards];
+}
+
+std::optional<std::string> MemEngine::get(const std::string& key) {
+  Shard& s = shard_for(key);
+  std::shared_lock lk(s.mu);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return std::nullopt;
+  return it->second;
+}
+
+bool MemEngine::set(const std::string& key, const std::string& value) {
+  Shard& s = shard_for(key);
+  std::unique_lock lk(s.mu);
+  s.map[key] = value;
+  return true;
+}
+
+bool MemEngine::del(const std::string& key) {
+  Shard& s = shard_for(key);
+  std::unique_lock lk(s.mu);
+  return s.map.erase(key) > 0;
+}
+
+bool MemEngine::exists(const std::string& key) {
+  Shard& s = shard_for(key);
+  std::shared_lock lk(s.mu);
+  return s.map.count(key) > 0;
+}
+
+std::vector<std::string> MemEngine::scan(const std::string& prefix) {
+  std::vector<std::string> out;
+  for (Shard& s : shards_) {
+    std::shared_lock lk(s.mu);
+    for (const auto& [k, v] : s.map) {
+      (void)v;
+      if (k.compare(0, prefix.size(), prefix) == 0) out.push_back(k);
+    }
+  }
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+size_t MemEngine::dbsize() {
+  size_t n = 0;
+  for (Shard& s : shards_) {
+    std::shared_lock lk(s.mu);
+    n += s.map.size();
+  }
+  return n;
+}
+
+size_t MemEngine::memory_usage() {
+  size_t n = 0;
+  for (Shard& s : shards_) {
+    std::shared_lock lk(s.mu);
+    for (const auto& [k, v] : s.map) n += k.size() + v.size();
+  }
+  return n;
+}
+
+Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
+  Shard& s = shard_for(key);
+  std::unique_lock lk(s.mu);
+  int64_t cur = 0;
+  auto it = s.map.find(key);
+  if (it != s.map.end() && !parse_i64(it->second, &cur)) {
+    return Result<int64_t>::Err(not_a_number(key));
+  }
+  // Wrapping add (reference release-mode semantics).
+  int64_t next = int64_t(uint64_t(cur) + uint64_t(delta));
+  s.map[key] = std::to_string(next);
+  return Result<int64_t>::Ok(next);
+}
+
+Result<int64_t> MemEngine::increment(const std::string& key, int64_t amount) {
+  return add(key, amount);
+}
+
+Result<int64_t> MemEngine::decrement(const std::string& key, int64_t amount) {
+  return add(key, int64_t(0 - uint64_t(amount)));
+}
+
+Result<std::string> MemEngine::splice(const std::string& key,
+                                      const std::string& value, bool append) {
+  Shard& s = shard_for(key);
+  std::unique_lock lk(s.mu);
+  auto it = s.map.find(key);
+  std::string next;
+  if (it == s.map.end()) {
+    next = value;
+  } else if (append) {
+    next = it->second + value;
+  } else {
+    next = value + it->second;
+  }
+  s.map[key] = next;
+  return Result<std::string>::Ok(next);
+}
+
+Result<std::string> MemEngine::append(const std::string& key,
+                                      const std::string& value) {
+  return splice(key, value, true);
+}
+
+Result<std::string> MemEngine::prepend(const std::string& key,
+                                       const std::string& value) {
+  return splice(key, value, false);
+}
+
+bool MemEngine::truncate() {
+  for (Shard& s : shards_) {
+    std::unique_lock lk(s.mu);
+    s.map.clear();
+  }
+  return true;
+}
+
+std::vector<std::pair<std::string, std::string>> MemEngine::snapshot() {
+  std::vector<std::pair<std::string, std::string>> out;
+  for (Shard& s : shards_) {
+    std::shared_lock lk(s.mu);
+    for (const auto& kv : s.map) out.push_back(kv);
+  }
+  std::sort(out.begin(), out.end(),
+            [](const auto& a, const auto& b) { return a.first < b.first; });
+  return out;
+}
+
+// ------------------------------------------------------------- LogEngine
+//
+// Log record: u8 op (1=SET, 2=DEL, 3=TRUNCATE) | u32 klen | u32 vlen |
+// key bytes | value bytes, little-endian lengths. A torn tail record (short
+// read) is discarded on replay.
+
+namespace {
+constexpr uint8_t kOpSet = 1;
+constexpr uint8_t kOpDel = 2;
+constexpr uint8_t kOpTruncate = 3;
+
+bool read_exact(int fd, void* buf, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (len) {
+    ssize_t r = ::read(fd, p, len);
+    if (r <= 0) return false;
+    p += r;
+    len -= size_t(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (len) {
+    ssize_t r = ::write(fd, p, len);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    len -= size_t(r);
+  }
+  return true;
+}
+}  // namespace
+
+LogEngine::LogEngine(const std::string& dir) {
+  ::mkdir(dir.c_str(), 0755);
+  path_ = dir + "/data.log";
+  int rfd = ::open(path_.c_str(), O_RDONLY);
+  if (rfd >= 0) {
+    for (;;) {
+      uint8_t op;
+      uint32_t klen, vlen;
+      if (!read_exact(rfd, &op, 1) || !read_exact(rfd, &klen, 4) ||
+          !read_exact(rfd, &vlen, 4)) {
+        break;
+      }
+      if (klen > (64u << 20) || vlen > (64u << 20)) break;  // corrupt tail
+      std::string key(klen, '\0'), value(vlen, '\0');
+      if (klen && !read_exact(rfd, key.data(), klen)) break;
+      if (vlen && !read_exact(rfd, value.data(), vlen)) break;
+      if (op == kOpSet) {
+        mem_.set(key, value);
+      } else if (op == kOpDel) {
+        mem_.del(key);
+      } else if (op == kOpTruncate) {
+        mem_.truncate();
+      } else {
+        break;
+      }
+    }
+    ::close(rfd);
+  }
+  fd_ = ::open(path_.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+}
+
+LogEngine::~LogEngine() {
+  if (fd_ >= 0) {
+    ::fsync(fd_);
+    ::close(fd_);
+  }
+}
+
+bool LogEngine::append_record(uint8_t op, const std::string& key,
+                              const std::string& value) {
+  if (fd_ < 0) return false;
+  std::string rec;
+  rec.reserve(9 + key.size() + value.size());
+  rec.push_back(char(op));
+  uint32_t klen = uint32_t(key.size()), vlen = uint32_t(value.size());
+  rec.append(reinterpret_cast<const char*>(&klen), 4);
+  rec.append(reinterpret_cast<const char*>(&vlen), 4);
+  rec.append(key);
+  rec.append(value);
+  return write_all(fd_, rec.data(), rec.size());
+}
+
+std::optional<std::string> LogEngine::get(const std::string& key) {
+  return mem_.get(key);
+}
+
+bool LogEngine::set(const std::string& key, const std::string& value) {
+  // Mutations serialize on log_mu_ so replay order matches final state.
+  std::unique_lock lk(log_mu_);
+  if (!mem_.set(key, value)) return false;
+  return append_record(kOpSet, key, value);
+}
+
+bool LogEngine::del(const std::string& key) {
+  std::unique_lock lk(log_mu_);
+  bool existed = mem_.del(key);
+  if (existed) append_record(kOpDel, key, "");
+  return existed;
+}
+
+bool LogEngine::exists(const std::string& key) { return mem_.exists(key); }
+
+std::vector<std::string> LogEngine::scan(const std::string& prefix) {
+  return mem_.scan(prefix);
+}
+
+size_t LogEngine::dbsize() { return mem_.dbsize(); }
+size_t LogEngine::memory_usage() { return mem_.memory_usage(); }
+
+Result<int64_t> LogEngine::increment(const std::string& key, int64_t amount) {
+  std::unique_lock lk(log_mu_);
+  auto r = mem_.increment(key, amount);
+  if (r.ok) append_record(kOpSet, key, std::to_string(r.value));
+  return r;
+}
+
+Result<int64_t> LogEngine::decrement(const std::string& key, int64_t amount) {
+  std::unique_lock lk(log_mu_);
+  auto r = mem_.decrement(key, amount);
+  if (r.ok) append_record(kOpSet, key, std::to_string(r.value));
+  return r;
+}
+
+Result<std::string> LogEngine::append(const std::string& key,
+                                      const std::string& value) {
+  std::unique_lock lk(log_mu_);
+  auto r = mem_.append(key, value);
+  if (r.ok) append_record(kOpSet, key, r.value);
+  return r;
+}
+
+Result<std::string> LogEngine::prepend(const std::string& key,
+                                       const std::string& value) {
+  std::unique_lock lk(log_mu_);
+  auto r = mem_.prepend(key, value);
+  if (r.ok) append_record(kOpSet, key, r.value);
+  return r;
+}
+
+bool LogEngine::truncate() {
+  std::unique_lock lk(log_mu_);
+  mem_.truncate();
+  // Truncating makes all history dead weight: restart the log.
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = ::open(path_.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  return fd_ >= 0;
+}
+
+bool LogEngine::sync() {
+  std::shared_lock lk(log_mu_);
+  return fd_ >= 0 && ::fsync(fd_) == 0;
+}
+
+std::vector<std::pair<std::string, std::string>> LogEngine::snapshot() {
+  return mem_.snapshot();
+}
+
+bool LogEngine::compact() {
+  std::unique_lock lk(log_mu_);
+  auto snap = mem_.snapshot();
+  std::string tmp = path_ + ".compact";
+  int nfd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (nfd < 0) return false;
+  for (const auto& [k, v] : snap) {
+    std::string rec;
+    rec.push_back(char(kOpSet));
+    uint32_t klen = uint32_t(k.size()), vlen = uint32_t(v.size());
+    rec.append(reinterpret_cast<const char*>(&klen), 4);
+    rec.append(reinterpret_cast<const char*>(&vlen), 4);
+    rec.append(k);
+    rec.append(v);
+    if (!write_all(nfd, rec.data(), rec.size())) {
+      ::close(nfd);
+      ::unlink(tmp.c_str());
+      return false;
+    }
+  }
+  ::fsync(nfd);
+  ::close(nfd);
+  if (::rename(tmp.c_str(), path_.c_str()) != 0) return false;
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = ::open(path_.c_str(), O_WRONLY | O_APPEND, 0644);
+  return fd_ >= 0;
+}
+
+// ------------------------------------------------------------- factory
+
+std::unique_ptr<Engine> make_engine(const std::string& kind,
+                                    const std::string& path) {
+  if (kind == "log" || kind == "sled") {
+    return std::make_unique<LogEngine>(path.empty() ? "merklekv_data" : path);
+  }
+  // "mem", "rwlock", "kv", "" — all map to the sharded in-memory engine.
+  return std::make_unique<MemEngine>();
+}
+
+}  // namespace mkv

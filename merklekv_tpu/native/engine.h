@@ -1,0 +1,143 @@
+// Storage engines for the native host runtime.
+//
+// Equivalent of the reference's KVEngineStoreTrait plugin boundary
+// (/root/reference/src/store/kv_trait.rs:23-162) and its engines
+// (rwlock_engine.rs, kv_engine.rs, sled_engine.rs), redesigned for the
+// TPU-native architecture:
+//   - the keyspace is SHARDED (N shards, each its own shared_mutex + map)
+//     instead of one global lock — the reference serializes every op behind
+//     a single tokio Mutex (/root/reference/src/server.rs:386), which its
+//     own docs call the biggest bottleneck;
+//   - `snapshot()` exports the whole (sorted) keyspace in one call so the
+//     TPU data plane can rebuild Merkle state as a batched program.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <optional>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace mkv {
+
+template <typename T>
+struct Result {
+  bool ok = false;
+  T value{};
+  std::string error;
+  static Result Ok(T v) { return Result{true, std::move(v), {}}; }
+  static Result Err(std::string e) { return Result{false, {}, std::move(e)}; }
+};
+
+class Engine {
+ public:
+  virtual ~Engine() = default;
+
+  virtual std::optional<std::string> get(const std::string& key) = 0;
+  virtual bool set(const std::string& key, const std::string& value) = 0;
+  virtual bool del(const std::string& key) = 0;  // true if the key existed
+  virtual bool exists(const std::string& key) = 0;
+  // Sorted keys with the given prefix ("" = all).
+  virtual std::vector<std::string> scan(const std::string& prefix) = 0;
+  virtual size_t dbsize() = 0;
+  virtual size_t memory_usage() = 0;  // bytes (keys + values)
+  // Missing key counts as 0 (reference rwlock_engine.rs:252-320); non-numeric
+  // stored value is an error.
+  virtual Result<int64_t> increment(const std::string& key, int64_t amount) = 0;
+  virtual Result<int64_t> decrement(const std::string& key, int64_t amount) = 0;
+  // Create-if-missing (reference rwlock_engine.rs:337-390); returns new value.
+  virtual Result<std::string> append(const std::string& key,
+                                     const std::string& value) = 0;
+  virtual Result<std::string> prepend(const std::string& key,
+                                      const std::string& value) = 0;
+  virtual bool truncate() = 0;  // drop all keys
+  virtual bool sync() = 0;      // flush to durable storage (no-op in-mem)
+  // Whole keyspace, sorted by key — the TPU rebuild input.
+  virtual std::vector<std::pair<std::string, std::string>> snapshot() = 0;
+};
+
+// In-memory engine: 16-way sharded hash map, per-shard reader/writer locks.
+class MemEngine : public Engine {
+ public:
+  static constexpr size_t kShards = 16;
+
+  std::optional<std::string> get(const std::string& key) override;
+  bool set(const std::string& key, const std::string& value) override;
+  bool del(const std::string& key) override;
+  bool exists(const std::string& key) override;
+  std::vector<std::string> scan(const std::string& prefix) override;
+  size_t dbsize() override;
+  size_t memory_usage() override;
+  Result<int64_t> increment(const std::string& key, int64_t amount) override;
+  Result<int64_t> decrement(const std::string& key, int64_t amount) override;
+  Result<std::string> append(const std::string& key,
+                             const std::string& value) override;
+  Result<std::string> prepend(const std::string& key,
+                              const std::string& value) override;
+  bool truncate() override;
+  bool sync() override { return true; }
+  std::vector<std::pair<std::string, std::string>> snapshot() override;
+
+ private:
+  struct Shard {
+    mutable std::shared_mutex mu;
+    std::unordered_map<std::string, std::string> map;
+  };
+  Shard& shard_for(const std::string& key);
+  Result<int64_t> add(const std::string& key, int64_t delta);
+  Result<std::string> splice(const std::string& key, const std::string& value,
+                             bool append);
+
+  Shard shards_[kShards];
+};
+
+// Durable engine: MemEngine semantics + append-only operation log
+// (equivalent capability to the reference's sled engine,
+// /root/reference/src/store/sled_engine.rs). Replays the log on open;
+// `sync()` fsyncs; `truncate()`/compaction rewrite a fresh snapshot log.
+class LogEngine : public Engine {
+ public:
+  // Creates `dir` if needed; replays `dir`/data.log when present.
+  explicit LogEngine(const std::string& dir);
+  ~LogEngine() override;
+
+  std::optional<std::string> get(const std::string& key) override;
+  bool set(const std::string& key, const std::string& value) override;
+  bool del(const std::string& key) override;
+  bool exists(const std::string& key) override;
+  std::vector<std::string> scan(const std::string& prefix) override;
+  size_t dbsize() override;
+  size_t memory_usage() override;
+  Result<int64_t> increment(const std::string& key, int64_t amount) override;
+  Result<int64_t> decrement(const std::string& key, int64_t amount) override;
+  Result<std::string> append(const std::string& key,
+                             const std::string& value) override;
+  Result<std::string> prepend(const std::string& key,
+                              const std::string& value) override;
+  bool truncate() override;
+  bool sync() override;
+  std::vector<std::pair<std::string, std::string>> snapshot() override;
+
+  // Rewrite the log as a snapshot of live state (drops tombstones).
+  bool compact();
+
+ private:
+  bool log_set(const std::string& key, const std::string& value);
+  bool log_del(const std::string& key);
+  bool append_record(uint8_t op, const std::string& key,
+                     const std::string& value);
+
+  MemEngine mem_;
+  std::string path_;
+  std::shared_mutex log_mu_;
+  int fd_ = -1;
+};
+
+// Factory: kind is "mem" (default, aka "rwlock"/"kv") or "log" (aka "sled").
+std::unique_ptr<Engine> make_engine(const std::string& kind,
+                                    const std::string& path);
+
+}  // namespace mkv

@@ -1,0 +1,43 @@
+#include "events.h"
+
+#include <algorithm>
+#include <ctime>
+
+namespace mkv {
+
+namespace {
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+}
+}  // namespace
+
+void EventQueue::push(ChangeOp op, const std::string& key,
+                      const std::string& value, bool has_value) {
+  std::lock_guard lk(mu_);
+  if (q_.size() >= capacity_) {
+    q_.pop_front();
+    dropped_.fetch_add(1, std::memory_order_relaxed);
+  }
+  q_.push_back(ChangeRecord{op, has_value, now_ns(), next_seq_++, key, value});
+}
+
+std::vector<ChangeRecord> EventQueue::drain(size_t max_events) {
+  std::lock_guard lk(mu_);
+  size_t n = max_events == 0 ? q_.size() : std::min(max_events, q_.size());
+  std::vector<ChangeRecord> out;
+  out.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    out.push_back(std::move(q_.front()));
+    q_.pop_front();
+  }
+  return out;
+}
+
+size_t EventQueue::size() const {
+  std::lock_guard lk(mu_);
+  return q_.size();
+}
+
+}  // namespace mkv

@@ -1,0 +1,65 @@
+// Standalone server binary (reference analog: /root/reference/src/main.rs).
+//
+// The Python CLI (`python -m merklekv_tpu`) is the full-featured entry point
+// (TOML config, replication, anti-entropy, TPU data plane); this binary runs
+// the bare native server for ops/bench use with flag parity:
+//   merklekv-server [--host H] [--port P] [--engine mem|log]
+//                   [--storage-path DIR]
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "engine.h"
+#include "server.h"
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 7379;
+  std::string engine_kind = "mem";
+  std::string storage_path = "merklekv_data";
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "%s requires a value\n", flag);
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--host") {
+      host = next("--host");
+    } else if (a == "--port") {
+      port = std::atoi(next("--port"));
+    } else if (a == "--engine") {
+      engine_kind = next("--engine");
+    } else if (a == "--storage-path") {
+      storage_path = next("--storage-path");
+    } else if (a == "--help" || a == "-h") {
+      std::printf(
+          "usage: merklekv-server [--host H] [--port P] "
+          "[--engine mem|log] [--storage-path DIR]\n");
+      return 0;
+    } else {
+      std::fprintf(stderr, "unknown flag: %s\n", a.c_str());
+      return 2;
+    }
+  }
+
+  auto engine = mkv::make_engine(engine_kind, storage_path);
+  mkv::ServerOptions opts;
+  opts.host = host;
+  opts.port = uint16_t(port);
+  opts.exit_on_shutdown = true;
+  mkv::Server server(engine.get(), opts);
+  if (!server.start()) {
+    std::fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
+    return 1;
+  }
+  std::printf("merklekv-server listening on %s:%u (engine=%s)\n", host.c_str(),
+              server.port(), engine_kind.c_str());
+  std::fflush(stdout);
+  server.wait();
+  return 0;
+}

@@ -1,0 +1,27 @@
+// Host-side Merkle root over a sorted (key, value) snapshot.
+//
+// Bit-identical to the reference tree (/root/reference/src/store/merkle.rs:
+// length-prefixed leaf encoding :7-16, sorted leaves, pairwise bottom-up
+// build with odd-node promotion :73-121) and to the Python/TPU engines
+// (merklekv_tpu/merkle/encoding.py). Used by the HASH command so the native
+// server answers without a device round-trip; bulk rebuild/diff runs on TPU.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace mkv {
+
+// leaf = SHA256(u32_be(len k) || k || u32_be(len v) || v)
+void leaf_hash(const std::string& key, const std::string& value,
+               uint8_t out[32]);
+
+// Root over (key, value) pairs; sorts by key internally. Returns false (and
+// leaves `out` untouched) for an empty snapshot — the protocol encodes the
+// empty tree as 64 zeros.
+bool merkle_root(std::vector<std::pair<std::string, std::string>> items,
+                 uint8_t out[32]);
+
+}  // namespace mkv

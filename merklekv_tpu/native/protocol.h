@@ -1,0 +1,54 @@
+// Text protocol: one CRLF-terminated line -> Command.
+//
+// Reproduces the reference parser's grammar, validation rules, and error
+// messages exactly (/root/reference/src/protocol.rs:237-774): case-insensitive
+// verbs; tabs forbidden in commands/keys but allowed in values; newlines
+// forbidden everywhere inside a line; SET/APPEND/PREPEND split on the first
+// two spaces so values may contain spaces; EXISTS/MGET/MSET/INC/DEC split on
+// whitespace runs.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace mkv {
+
+enum class Verb {
+  Get, Set, Delete, Increment, Decrement, Append, Prepend,
+  MultiGet, MultiSet, Truncate, Exists, Scan, Dbsize, Hash,
+  Stats, Info, Version, Memory, ClientList, Flushdb, Shutdown,
+  Ping, Echo, Sync, Replicate,
+};
+
+enum class ReplicateAction { Enable, Disable, Status };
+
+struct Command {
+  Verb verb{};
+  std::string key;                 // Get/Set/Delete/Inc/Dec/Append/Prepend
+  std::string value;               // Set/Append/Prepend
+  std::optional<int64_t> amount;   // Inc/Dec
+  std::vector<std::string> keys;   // Exists/MultiGet
+  std::vector<std::pair<std::string, std::string>> pairs;  // MultiSet
+  std::string message;             // Ping/Echo
+  std::string prefix;              // Scan
+  std::optional<std::string> pattern;  // Hash
+  std::string host;                // Sync
+  uint16_t port = 0;               // Sync
+  bool full = false, verify = false;  // Sync flags (parsed, ignored — parity)
+  ReplicateAction action{};        // Replicate
+};
+
+struct ParseResult {
+  bool ok = false;
+  Command cmd;
+  std::string error;
+};
+
+// `line` is the raw request line (trailing \r\n included or not — it is
+// trimmed here, like the reference's input.trim()).
+ParseResult parse_command(const std::string& line);
+
+}  // namespace mkv

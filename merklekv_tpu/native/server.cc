@@ -1,0 +1,376 @@
+#include "server.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+#include "merkle.h"
+#include "protocol.h"
+#include "sha256.h"
+
+namespace mkv {
+
+namespace {
+
+uint64_t unix_now() { return uint64_t(::time(nullptr)); }
+
+bool send_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t r = ::send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    off += size_t(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+Server::Server(Engine* engine, ServerOptions opts)
+    : engine_(engine), opts_(std::move(opts)) {}
+
+Server::~Server() {
+  stop();
+  wait();
+}
+
+bool Server::start() {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(opts_.port);
+  if (::inet_pton(AF_INET, opts_.host.c_str(), &addr.sin_addr) != 1) {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  }
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+      0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  if (::listen(listen_fd_, 1024) < 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&bound), &blen);
+  bound_port_ = ntohs(bound.sin_port);
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  return true;
+}
+
+void Server::stop() {
+  bool expected = false;
+  if (!stop_.compare_exchange_strong(expected, true)) {
+    // Already stopping; still make sure sockets are poked below.
+  }
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+  }
+  std::lock_guard lk(clients_mu_);
+  for (auto& [id, meta] : clients_) {
+    (void)id;
+    ::shutdown(meta->fd, SHUT_RDWR);
+  }
+}
+
+void Server::wait() {
+  if (accept_thread_.joinable()) accept_thread_.join();
+  // Handler threads are detached; spin briefly until they all unregister.
+  while (live_handlers_.load(std::memory_order_acquire) > 0) {
+    ::usleep(1000);
+  }
+}
+
+void Server::set_cluster_callback(ClusterCallback cb) {
+  std::lock_guard lk(cb_mu_);
+  cluster_cb_ = std::move(cb);
+}
+
+void Server::accept_loop() {
+  for (;;) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &plen);
+    if (fd < 0) {
+      if (stop_.load(std::memory_order_acquire)) break;
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (stop_.load(std::memory_order_acquire)) {
+      ::close(fd);
+      break;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+    char ip[INET_ADDRSTRLEN] = "?";
+    ::inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+    auto meta = std::make_shared<ClientMeta>();
+    meta->id = next_client_id_.fetch_add(1);
+    meta->addr = std::string(ip) + ":" + std::to_string(ntohs(peer.sin_port));
+    meta->connected_unix = unix_now();
+    meta->last_cmd_unix.store(meta->connected_unix);
+    meta->fd = fd;
+    {
+      std::lock_guard lk(clients_mu_);
+      clients_[meta->id] = meta;
+    }
+    stats_.total_connections++;
+    stats_.active_connections++;
+    live_handlers_.fetch_add(1, std::memory_order_acq_rel);
+    std::thread([this, fd, meta] {
+      bool shutdown_req = handle_connection(fd, meta);
+      {
+        // Deregister before closing so stop() never pokes a recycled fd.
+        std::lock_guard lk(clients_mu_);
+        clients_.erase(meta->id);
+      }
+      ::close(fd);
+      stats_.active_connections--;
+      live_handlers_.fetch_sub(1, std::memory_order_acq_rel);
+      if (shutdown_req) {
+        if (opts_.exit_on_shutdown) {
+          // Reference parity: SHUTDOWN exits the process (server.rs:909-923).
+          std::exit(0);
+        }
+        stop();
+      }
+    }).detach();
+  }
+  if (listen_fd_ >= 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
+  std::string buf;
+  char chunk[65536];
+  for (;;) {
+    // Extract complete lines already buffered.
+    size_t nl;
+    while ((nl = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, nl + 1);
+      buf.erase(0, nl + 1);
+      if (line.size() > opts_.max_line) {
+        send_all(fd, "ERROR line too long\r\n");
+        return false;
+      }
+      auto parsed = parse_command(line);
+      if (!parsed.ok) {
+        if (!send_all(fd, "ERROR " + parsed.error + "\r\n")) return false;
+        continue;
+      }
+      meta->last_cmd_unix.store(unix_now(), std::memory_order_relaxed);
+      stats_.count(parsed.cmd);
+      bool close_conn = false;
+      std::string response = dispatch(parsed.cmd, &close_conn);
+      if (!send_all(fd, response)) return false;
+      if (close_conn) return true;
+    }
+    if (buf.size() > opts_.max_line) {
+      send_all(fd, "ERROR line too long\r\n");
+      return false;
+    }
+    ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (r <= 0) return false;
+    buf.append(chunk, size_t(r));
+  }
+}
+
+std::string Server::dispatch(const Command& cmd, bool* close_conn) {
+  switch (cmd.verb) {
+    case Verb::Get: {
+      auto v = engine_->get(cmd.key);
+      return v ? "VALUE " + *v + "\r\n" : "NOT_FOUND\r\n";
+    }
+    case Verb::Ping:
+      return "PONG " + cmd.message + "\r\n";
+    case Verb::Echo:
+      return "ECHO " + cmd.message + "\r\n";
+    case Verb::Dbsize:
+      return "DBSIZE " + std::to_string(engine_->dbsize()) + "\r\n";
+    case Verb::Exists: {
+      size_t count = 0;
+      for (const auto& k : cmd.keys) {
+        if (engine_->exists(k)) ++count;
+      }
+      return "EXISTS " + std::to_string(count) + "\r\n";
+    }
+    case Verb::Scan: {
+      auto keys = engine_->scan(cmd.prefix);
+      std::string out = "KEYS " + std::to_string(keys.size()) + "\r\n";
+      for (const auto& k : keys) out += k + "\r\n";
+      return out;
+    }
+    case Verb::Set: {
+      if (!engine_->set(cmd.key, cmd.value)) return "ERROR set failed\r\n";
+      events_.push(ChangeOp::Set, cmd.key, cmd.value, true);
+      return "OK\r\n";
+    }
+    case Verb::Delete: {
+      if (engine_->del(cmd.key)) {
+        events_.push(ChangeOp::Del, cmd.key, "", false);
+        return "DELETED\r\n";
+      }
+      return "NOT_FOUND\r\n";
+    }
+    case Verb::Memory:
+      return "MEMORY " + std::to_string(engine_->memory_usage()) + "\r\n";
+    case Verb::ClientList: {
+      std::string out = "CLIENT LIST\r\n";
+      uint64_t now = unix_now();
+      std::lock_guard lk(clients_mu_);
+      for (const auto& [id, c] : clients_) {
+        uint64_t last = c->last_cmd_unix.load(std::memory_order_relaxed);
+        uint64_t age = now >= c->connected_unix ? now - c->connected_unix : 0;
+        uint64_t idle = now >= last ? now - last : 0;
+        out += "id=" + std::to_string(c->id) + " addr=" + c->addr +
+               " age=" + std::to_string(age) + " idle=" + std::to_string(idle) +
+               "\r\n";
+      }
+      out += "END\r\n";
+      return out;
+    }
+    case Verb::Sync:
+    case Verb::Replicate: {
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        // Reconstruct a canonical line for the callback.
+        std::string line;
+        if (cmd.verb == Verb::Sync) {
+          line = "SYNC " + cmd.host + " " + std::to_string(cmd.port);
+          if (cmd.full) line += " --full";
+          if (cmd.verify) line += " --verify";
+        } else {
+          line = "REPLICATE ";
+          line += cmd.action == ReplicateAction::Enable    ? "enable"
+                  : cmd.action == ReplicateAction::Disable ? "disable"
+                                                           : "status";
+        }
+        std::string resp = cb(line);
+        if (!resp.empty()) return resp;
+      }
+      if (cmd.verb == Verb::Replicate &&
+          cmd.action == ReplicateAction::Status) {
+        return "REPLICATION disabled\r\n";
+      }
+      if (cmd.verb == Verb::Replicate &&
+          cmd.action == ReplicateAction::Disable) {
+        return "OK\r\n";
+      }
+      return "ERROR replication not configured\r\n";
+    }
+    case Verb::Hash: {
+      // Pattern semantics (server.rs:647-658): absent or "*" = all keys;
+      // otherwise a plain prefix.
+      std::string pat = cmd.pattern.value_or("");
+      std::string prefix = (pat == "*") ? "" : pat;
+      auto keys = engine_->scan(prefix);
+      std::vector<std::pair<std::string, std::string>> items;
+      items.reserve(keys.size());
+      for (const auto& k : keys) {
+        if (auto v = engine_->get(k)) items.emplace_back(k, *v);
+      }
+      uint8_t root[32];
+      std::string hex = merkle_root(std::move(items), root)
+                            ? digest_hex(root)
+                            : std::string(64, '0');
+      if (pat.empty()) return "HASH " + hex + "\r\n";
+      return "HASH " + pat + " " + hex + "\r\n";
+    }
+    case Verb::Increment:
+    case Verb::Decrement: {
+      int64_t amount = cmd.amount.value_or(1);
+      auto r = cmd.verb == Verb::Increment ? engine_->increment(cmd.key, amount)
+                                           : engine_->decrement(cmd.key, amount);
+      if (!r.ok) return "ERROR " + r.error + "\r\n";
+      events_.push(
+          cmd.verb == Verb::Increment ? ChangeOp::Incr : ChangeOp::Decr,
+          cmd.key, std::to_string(r.value), true);
+      return "VALUE " + std::to_string(r.value) + "\r\n";
+    }
+    case Verb::Append:
+    case Verb::Prepend: {
+      // Empty value: report current value, never mutate (server.rs:772-779).
+      if (cmd.value.empty()) {
+        auto v = engine_->get(cmd.key);
+        return v ? "VALUE " + *v + "\r\n" : "ERROR Key not found\r\n";
+      }
+      auto r = cmd.verb == Verb::Append ? engine_->append(cmd.key, cmd.value)
+                                        : engine_->prepend(cmd.key, cmd.value);
+      if (!r.ok) return "ERROR " + r.error + "\r\n";
+      events_.push(
+          cmd.verb == Verb::Append ? ChangeOp::Append : ChangeOp::Prepend,
+          cmd.key, r.value, true);
+      return "VALUE " + r.value + "\r\n";
+    }
+    case Verb::MultiGet: {
+      std::string body;
+      size_t found = 0;
+      for (const auto& k : cmd.keys) {
+        if (auto v = engine_->get(k)) {
+          body += k + " " + *v + "\r\n";
+          ++found;
+        } else {
+          body += k + " NOT_FOUND\r\n";
+        }
+      }
+      if (found == 0) return "NOT_FOUND\r\n";
+      return "VALUES " + std::to_string(found) + "\r\n" + body;
+    }
+    case Verb::MultiSet: {
+      for (const auto& [k, v] : cmd.pairs) {
+        if (!engine_->set(k, v)) return "ERROR set failed\r\n";
+        events_.push(ChangeOp::Set, k, v, true);
+      }
+      return "OK\r\n";
+    }
+    case Verb::Truncate:
+    case Verb::Flushdb: {
+      // FLUSHDB truncates, like the reference (server.rs:901-908).
+      if (!engine_->truncate()) return "ERROR truncate failed\r\n";
+      return "OK\r\n";
+    }
+    case Verb::Stats:
+      return "STATS\r\n" + stats_.format_stats();
+    case Verb::Info: {
+      std::string out = "INFO\r\n";
+      out += "version:" + opts_.version + "\r\n";
+      out += "uptime_seconds:" + std::to_string(stats_.uptime_seconds()) +
+             "\r\n";
+      out += "uptime:" + stats_.uptime_human() + "\r\n";
+      out += "server_time_unix:" + std::to_string(unix_now()) + "\r\n";
+      out += "db_keys:" + std::to_string(engine_->dbsize()) + "\r\n";
+      return out;
+    }
+    case Verb::Version:
+      return "VERSION " + opts_.version + "\r\n";
+    case Verb::Shutdown:
+      *close_conn = true;
+      return "OK\r\n";
+  }
+  return "ERROR internal\r\n";
+}
+
+}  // namespace mkv

@@ -1,0 +1,96 @@
+// Native TCP server: CRLF text protocol over task-per-connection threads.
+//
+// Equivalent of the reference's tokio server (/root/reference/src/server.rs:
+// 376-958): accept loop, one handler per connection, 1 MiB line cap, stats,
+// client table, and post-write event publication. Differences by design:
+//   - engine calls go straight to the SHARDED engine — there is no global
+//     store mutex like server.rs:386;
+//   - successful writes stage ChangeRecords in an EventQueue the control
+//     plane drains (instead of awaiting an in-process MQTT client);
+//   - SYNC / REPLICATE are delegated to a registered cluster callback (the
+//     Python/TPU control plane); without one they report unavailability;
+//   - SHUTDOWN optionally exits the process (standalone binary parity with
+//     server.rs:909-923) or just stops the server (embedded mode).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "engine.h"
+#include "events.h"
+#include "stats.h"
+
+namespace mkv {
+
+struct ClientMeta {
+  uint64_t id;
+  std::string addr;
+  uint64_t connected_unix;
+  std::atomic<uint64_t> last_cmd_unix;
+  int fd;
+};
+
+// Returns the full response (without trailing CRLF appended — the callback
+// provides the complete payload) for a cluster command line, or empty to
+// signal "not handled".
+using ClusterCallback = std::function<std::string(const std::string& line)>;
+
+struct ServerOptions {
+  std::string host = "127.0.0.1";
+  uint16_t port = 7379;  // 0 = ephemeral
+  std::string version = "0.1.0";
+  bool exit_on_shutdown = false;
+  size_t max_line = 1024 * 1024;
+};
+
+class Server {
+ public:
+  Server(Engine* engine, ServerOptions opts);
+  ~Server();
+
+  // Bind + listen + spawn the accept thread. Returns false on bind failure.
+  bool start();
+  // Actual bound port (after start(), useful with port 0).
+  uint16_t port() const { return bound_port_; }
+  // Request stop: closes the listener and all client sockets.
+  void stop();
+  // True once stop was requested (by stop() or a SHUTDOWN command).
+  bool stopping() const { return stop_.load(std::memory_order_acquire); }
+  // Block until the accept loop has exited.
+  void wait();
+
+  void set_cluster_callback(ClusterCallback cb);
+  EventQueue& events() { return events_; }
+  ServerStats& stats() { return stats_; }
+
+ private:
+  void accept_loop();
+  // Returns true if the connection requested server shutdown.
+  bool handle_connection(int fd, std::shared_ptr<ClientMeta> meta);
+  std::string dispatch(const Command& cmd, bool* close_conn);
+
+  Engine* engine_;
+  ServerOptions opts_;
+  ServerStats stats_;
+  EventQueue events_;
+  int listen_fd_ = -1;
+  uint16_t bound_port_ = 0;
+  std::thread accept_thread_;
+  std::atomic<bool> stop_{false};
+  std::atomic<uint64_t> next_client_id_{1};
+  std::atomic<uint64_t> live_handlers_{0};
+
+  std::mutex clients_mu_;
+  std::map<uint64_t, std::shared_ptr<ClientMeta>> clients_;
+
+  std::mutex cb_mu_;
+  ClusterCallback cluster_cb_;
+};
+
+}  // namespace mkv

@@ -1,0 +1,150 @@
+// Server observability counters.
+//
+// Field set, STATS line order, and counter->command mapping mirror the
+// reference's ServerStats (/root/reference/src/server.rs:52-321) including
+// its quirks: FLUSHDB and CLIENT LIST increment `management_commands`, so
+// the dedicated `flushdb_commands`/`clientlist_commands` lines always read 0
+// (server.rs:255-262). RSS comes from /proc/self/status instead of shelling
+// out to `ps` (server.rs:306-315) — same number, no subprocess.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+
+#include "protocol.h"
+
+namespace mkv {
+
+struct ServerStats {
+  using Clock = std::chrono::steady_clock;
+  Clock::time_point start_time = Clock::now();
+
+  std::atomic<uint64_t> total_connections{0};
+  std::atomic<uint64_t> active_connections{0};
+  std::atomic<uint64_t> total_commands{0};
+  std::atomic<uint64_t> get_commands{0};
+  std::atomic<uint64_t> scan_commands{0};
+  std::atomic<uint64_t> ping_commands{0};
+  std::atomic<uint64_t> echo_commands{0};
+  std::atomic<uint64_t> flushdb_commands{0};
+  std::atomic<uint64_t> memory_commands{0};
+  std::atomic<uint64_t> clientlist_commands{0};
+  std::atomic<uint64_t> exists_commands{0};
+  std::atomic<uint64_t> dbsize_commands{0};
+  std::atomic<uint64_t> set_commands{0};
+  std::atomic<uint64_t> delete_commands{0};
+  std::atomic<uint64_t> numeric_commands{0};
+  std::atomic<uint64_t> string_commands{0};
+  std::atomic<uint64_t> bulk_commands{0};
+  std::atomic<uint64_t> stat_commands{0};
+  std::atomic<uint64_t> sync_commands{0};
+  std::atomic<uint64_t> hash_commands{0};
+  std::atomic<uint64_t> replicate_commands{0};
+  std::atomic<uint64_t> management_commands{0};
+
+  uint64_t uptime_seconds() const {
+    return uint64_t(std::chrono::duration_cast<std::chrono::seconds>(
+                        Clock::now() - start_time)
+                        .count());
+  }
+
+  std::string uptime_human() const {
+    uint64_t s = uptime_seconds();
+    char buf[64];
+    std::snprintf(buf, sizeof(buf), "%llud %lluh %llum %llus",
+                  (unsigned long long)(s / 86400),
+                  (unsigned long long)((s % 86400) / 3600),
+                  (unsigned long long)((s % 3600) / 60),
+                  (unsigned long long)(s % 60));
+    return buf;
+  }
+
+  void count(const Command& cmd) {
+    total_commands.fetch_add(1, std::memory_order_relaxed);
+    switch (cmd.verb) {
+      case Verb::Get: get_commands++; break;
+      case Verb::Scan: scan_commands++; break;
+      case Verb::Ping: ping_commands++; break;
+      case Verb::Echo: echo_commands++; break;
+      case Verb::Dbsize: dbsize_commands++; break;
+      case Verb::Exists: exists_commands++; break;
+      case Verb::Set: set_commands++; break;
+      case Verb::Delete: delete_commands++; break;
+      case Verb::Increment:
+      case Verb::Decrement: numeric_commands++; break;
+      case Verb::Append:
+      case Verb::Prepend: string_commands++; break;
+      case Verb::MultiGet:
+      case Verb::MultiSet:
+      case Verb::Truncate: bulk_commands++; break;
+      case Verb::Stats:
+      case Verb::Info: stat_commands++; break;
+      case Verb::Version:
+      case Verb::Flushdb:
+      case Verb::Shutdown:
+      case Verb::ClientList: management_commands++; break;
+      case Verb::Memory: memory_commands++; break;
+      case Verb::Sync: sync_commands++; break;
+      case Verb::Hash: hash_commands++; break;
+      case Verb::Replicate: replicate_commands++; break;
+    }
+  }
+
+  static uint64_t rss_kb() {
+    FILE* f = std::fopen("/proc/self/status", "r");
+    if (!f) return 0;
+    char line[256];
+    uint64_t kb = 0;
+    while (std::fgets(line, sizeof(line), f)) {
+      if (std::sscanf(line, "VmRSS: %llu kB", (unsigned long long*)&kb) == 1) {
+        break;
+      }
+    }
+    std::fclose(f);
+    return kb;
+  }
+
+  std::string format_stats() const {
+    auto ld = [](const std::atomic<uint64_t>& a) {
+      return a.load(std::memory_order_relaxed);
+    };
+    std::string out;
+    char buf[128];
+    auto add = [&](const char* name, uint64_t v) {
+      std::snprintf(buf, sizeof(buf), "%s:%llu\r\n", name,
+                    (unsigned long long)v);
+      out += buf;
+    };
+    add("uptime_seconds", uptime_seconds());
+    out += "uptime:" + uptime_human() + "\r\n";
+    add("total_connections", ld(total_connections));
+    add("active_connections", ld(active_connections));
+    add("total_commands", ld(total_commands));
+    add("get_commands", ld(get_commands));
+    add("scan_commands", ld(scan_commands));
+    add("ping_commands", ld(ping_commands));
+    add("echo_commands", ld(echo_commands));
+    add("flushdb_commands", ld(flushdb_commands));
+    add("memory_commands", ld(memory_commands));
+    add("clientlist_commands", ld(clientlist_commands));
+    add("exists_commands", ld(exists_commands));
+    add("dbsize_commands", ld(dbsize_commands));
+    add("set_commands", ld(set_commands));
+    add("delete_commands", ld(delete_commands));
+    add("numeric_commands", ld(numeric_commands));
+    add("string_commands", ld(string_commands));
+    add("bulk_commands", ld(bulk_commands));
+    add("stat_commands", ld(stat_commands));
+    add("sync_commands", ld(sync_commands));
+    add("hash_commands", ld(hash_commands));
+    add("replicate_commands", ld(replicate_commands));
+    add("management_commands", ld(management_commands));
+    add("used_memory_kb", rss_kb());
+    return out;
+  }
+};
+
+}  // namespace mkv

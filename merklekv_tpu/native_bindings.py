@@ -1,0 +1,407 @@
+"""ctypes bindings to the native host runtime (libmerklekv.so).
+
+The C++ layer owns the hot path: sharded storage engines, the CRLF protocol
+parser, and the TCP server (merklekv_tpu/native/). This module is the
+control-plane handle the Python side uses to
+  - share one engine between the native server and the replication /
+    anti-entropy / TPU-Merkle subsystems,
+  - drain the change-event queue feeding replication and incremental
+    device updates,
+  - register the cluster callback that routes SYNC / REPLICATE commands
+    into Python.
+
+Reference analog: the Rust server owns everything in-process
+(/root/reference/src/main.rs:125-150); here the native runtime and the JAX
+data plane meet through this seam.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libmerklekv.so")
+_SERVER_BIN = os.path.join(_NATIVE_DIR, "merklekv-server")
+
+_CLUSTER_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_void_p,
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char),
+    ctypes.c_int,
+)
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def ensure_built() -> None:
+    """Build the native library if missing or stale (any source newer)."""
+    srcs = [
+        os.path.join(_NATIVE_DIR, f)
+        for f in os.listdir(_NATIVE_DIR)
+        if f.endswith((".cc", ".h", "Makefile"))
+    ]
+    if os.path.exists(_LIB_PATH):
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
+            return
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "-j", str(os.cpu_count() or 2)],
+        check=True,
+        capture_output=True,
+    )
+
+
+def server_binary() -> str:
+    """Path to the standalone merklekv-server binary (built on demand)."""
+    ensure_built()
+    return _SERVER_BIN
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    ensure_built()
+    lib = ctypes.CDLL(_LIB_PATH)
+
+    lib.mkv_free.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_create.restype = ctypes.c_void_p
+    lib.mkv_engine_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.mkv_engine_destroy.argtypes = [ctypes.c_void_p]
+
+    P = ctypes.POINTER
+    lib.mkv_engine_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        P(ctypes.c_void_p), P(ctypes.c_int),
+    ]
+    lib.mkv_engine_set.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.mkv_engine_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.mkv_engine_exists.argtypes = lib.mkv_engine_del.argtypes
+    lib.mkv_engine_dbsize.restype = ctypes.c_longlong
+    lib.mkv_engine_dbsize.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_memory_usage.restype = ctypes.c_longlong
+    lib.mkv_engine_memory_usage.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_truncate.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_sync.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_increment.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong,
+        P(ctypes.c_longlong), P(ctypes.c_void_p), P(ctypes.c_int),
+    ]
+    lib.mkv_engine_decrement.argtypes = lib.mkv_engine_increment.argtypes
+    lib.mkv_engine_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+        P(ctypes.c_void_p), P(ctypes.c_int), P(ctypes.c_void_p), P(ctypes.c_int),
+    ]
+    lib.mkv_engine_prepend.argtypes = lib.mkv_engine_append.argtypes
+    lib.mkv_engine_scan.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        P(ctypes.c_void_p), P(ctypes.c_int),
+    ]
+    lib.mkv_engine_snapshot.argtypes = [
+        ctypes.c_void_p, P(ctypes.c_void_p), P(ctypes.c_longlong),
+    ]
+    lib.mkv_engine_merkle_root.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+
+    lib.mkv_server_create.restype = ctypes.c_void_p
+    lib.mkv_server_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.mkv_server_start.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_port.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_stopping.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_stop.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_wait.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_set_cluster_cb.argtypes = [
+        ctypes.c_void_p, _CLUSTER_CB, ctypes.c_void_p,
+    ]
+    lib.mkv_server_drain_events.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
+    ]
+    lib.mkv_server_events_dropped.restype = ctypes.c_longlong
+    lib.mkv_server_events_dropped.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_stats.argtypes = [
+        ctypes.c_void_p, P(ctypes.c_void_p), P(ctypes.c_int),
+    ]
+    _lib = lib
+    return lib
+
+
+def _take_buffer(lib: ctypes.CDLL, ptr: ctypes.c_void_p, length: int) -> bytes:
+    try:
+        return ctypes.string_at(ptr, length)
+    finally:
+        lib.mkv_free(ptr)
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+class NativeEngine:
+    """Handle to a native storage engine (sharded in-memory or durable log)."""
+
+    def __init__(self, kind: str = "mem", path: str = "") -> None:
+        self._lib = _load()
+        self._h = self._lib.mkv_engine_create(kind.encode(), path.encode())
+        if not self._h:
+            raise NativeError(f"engine create failed: {kind}")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.mkv_engine_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- kv ops -------------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int()
+        if not self._lib.mkv_engine_get(
+            self._h, key, len(key), ctypes.byref(out), ctypes.byref(out_len)
+        ):
+            return None
+        return _take_buffer(self._lib, out, out_len.value)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if not self._lib.mkv_engine_set(self._h, key, len(key), value, len(value)):
+            raise NativeError("set failed")
+
+    def delete(self, key: bytes) -> bool:
+        return bool(self._lib.mkv_engine_del(self._h, key, len(key)))
+
+    def exists(self, key: bytes) -> bool:
+        return bool(self._lib.mkv_engine_exists(self._h, key, len(key)))
+
+    def dbsize(self) -> int:
+        return self._lib.mkv_engine_dbsize(self._h)
+
+    def memory_usage(self) -> int:
+        return self._lib.mkv_engine_memory_usage(self._h)
+
+    def truncate(self) -> None:
+        self._lib.mkv_engine_truncate(self._h)
+
+    def sync(self) -> None:
+        self._lib.mkv_engine_sync(self._h)
+
+    def increment(self, key: bytes, amount: int = 1) -> int:
+        return self._numeric(self._lib.mkv_engine_increment, key, amount)
+
+    def decrement(self, key: bytes, amount: int = 1) -> int:
+        return self._numeric(self._lib.mkv_engine_decrement, key, amount)
+
+    def _numeric(self, fn, key: bytes, amount: int) -> int:
+        val = ctypes.c_longlong()
+        err = ctypes.c_void_p()
+        err_len = ctypes.c_int()
+        if fn(
+            self._h, key, len(key), amount,
+            ctypes.byref(val), ctypes.byref(err), ctypes.byref(err_len),
+        ):
+            return val.value
+        raise NativeError(_take_buffer(self._lib, err, err_len.value).decode())
+
+    def append(self, key: bytes, value: bytes) -> bytes:
+        return self._splice(self._lib.mkv_engine_append, key, value)
+
+    def prepend(self, key: bytes, value: bytes) -> bytes:
+        return self._splice(self._lib.mkv_engine_prepend, key, value)
+
+    def _splice(self, fn, key: bytes, value: bytes) -> bytes:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int()
+        err = ctypes.c_void_p()
+        err_len = ctypes.c_int()
+        if fn(
+            self._h, key, len(key), value, len(value),
+            ctypes.byref(out), ctypes.byref(out_len),
+            ctypes.byref(err), ctypes.byref(err_len),
+        ):
+            return _take_buffer(self._lib, out, out_len.value)
+        raise NativeError(_take_buffer(self._lib, err, err_len.value).decode())
+
+    def scan(self, prefix: bytes = b"") -> list[bytes]:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int()
+        self._lib.mkv_engine_scan(
+            self._h, prefix, len(prefix), ctypes.byref(out), ctypes.byref(out_len)
+        )
+        buf = _take_buffer(self._lib, out, out_len.value)
+        (n,) = struct.unpack_from("<I", buf, 0)
+        keys, off = [], 4
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            keys.append(buf[off : off + klen])
+            off += klen
+        return keys
+
+    def snapshot(self) -> list[tuple[bytes, bytes]]:
+        """Whole keyspace sorted by key — the TPU Merkle rebuild input."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_longlong()
+        self._lib.mkv_engine_snapshot(
+            self._h, ctypes.byref(out), ctypes.byref(out_len)
+        )
+        buf = _take_buffer(self._lib, out, out_len.value)
+        (n,) = struct.unpack_from("<I", buf, 0)
+        items, off = [], 4
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            k = buf[off : off + klen]
+            off += klen
+            (vlen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            v = buf[off : off + vlen]
+            off += vlen
+            items.append((k, v))
+        return items
+
+    def merkle_root(self) -> Optional[bytes]:
+        out = ctypes.create_string_buffer(32)
+        if self._lib.mkv_engine_merkle_root(self._h, out):
+            return out.raw
+        return None
+
+
+@dataclass
+class ChangeEventRaw:
+    """One drained native change record (op kinds match events.h)."""
+
+    op: int
+    has_value: bool
+    ts_ns: int
+    seq: int
+    key: bytes
+    value: bytes
+
+
+OP_SET, OP_DEL, OP_INCR, OP_DECR, OP_APPEND, OP_PREPEND = 1, 2, 3, 4, 5, 6
+
+
+class NativeServer:
+    """Embedded native TCP server bound to a NativeEngine."""
+
+    def __init__(
+        self,
+        engine: NativeEngine,
+        host: str = "127.0.0.1",
+        port: int = 7379,
+        version: str = "0.1.0",
+        exit_on_shutdown: bool = False,
+    ) -> None:
+        self._lib = _load()
+        self._engine = engine  # keep alive
+        self._h = self._lib.mkv_server_create(
+            engine._h, host.encode(), port, version.encode(),
+            1 if exit_on_shutdown else 0,
+        )
+        self._cb_ref = None
+        if not self._h:
+            raise NativeError("server create failed")
+
+    def start(self) -> None:
+        if not self._lib.mkv_server_start(self._h):
+            raise NativeError("bind/listen failed")
+
+    @property
+    def port(self) -> int:
+        return self._lib.mkv_server_port(self._h)
+
+    @property
+    def stopping(self) -> bool:
+        return bool(self._lib.mkv_server_stopping(self._h))
+
+    def stop(self) -> None:
+        self._lib.mkv_server_stop(self._h)
+
+    def wait(self) -> None:
+        self._lib.mkv_server_wait(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.mkv_server_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def set_cluster_handler(
+        self, handler: Optional[Callable[[str], Optional[str]]]
+    ) -> None:
+        """Route SYNC/REPLICATE lines to `handler`; return the full response
+        text (CRLF included) or None to fall back to native defaults."""
+        if handler is None:
+            self._cb_ref = None
+            self._lib.mkv_server_set_cluster_cb(
+                self._h, ctypes.cast(None, _CLUSTER_CB), None
+            )
+            return
+
+        def trampoline(_ctx, line, out_buf, out_cap):
+            try:
+                resp = handler(line.decode())
+            except Exception as e:  # never let exceptions cross the FFI
+                resp = f"ERROR {e}\r\n"
+            if resp is None:
+                return 0
+            data = resp.encode()[: out_cap - 1]
+            ctypes.memmove(out_buf, data, len(data))
+            return len(data)
+
+        self._cb_ref = _CLUSTER_CB(trampoline)  # keep trampoline alive
+        self._lib.mkv_server_set_cluster_cb(self._h, self._cb_ref, None)
+
+    def drain_events(self, max_events: int = 0) -> list[ChangeEventRaw]:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_longlong()
+        self._lib.mkv_server_drain_events(
+            self._h, max_events, ctypes.byref(out), ctypes.byref(out_len)
+        )
+        buf = _take_buffer(self._lib, out, out_len.value)
+        (n,) = struct.unpack_from("<I", buf, 0)
+        events, off = [], 4
+        for _ in range(n):
+            op, has_value = buf[off], bool(buf[off + 1])
+            ts_ns, seq = struct.unpack_from("<QQ", buf, off + 2)
+            off += 18
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            key = buf[off : off + klen]
+            off += klen
+            (vlen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            value = buf[off : off + vlen]
+            off += vlen
+            events.append(ChangeEventRaw(op, has_value, ts_ns, seq, key, value))
+        return events
+
+    def events_dropped(self) -> int:
+        return self._lib.mkv_server_events_dropped(self._h)
+
+    def stats_text(self) -> str:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int()
+        self._lib.mkv_server_stats(self._h, ctypes.byref(out), ctypes.byref(out_len))
+        return _take_buffer(self._lib, out, out_len.value).decode()

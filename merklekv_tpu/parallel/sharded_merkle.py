@@ -27,12 +27,27 @@ from merklekv_tpu.merkle.jax_engine import build_levels_device
 from merklekv_tpu.merkle.diff import divergence_masks
 from merklekv_tpu.ops.sha256 import sha256_blocks
 
-__all__ = ["sharded_tree_root", "sharded_divergence", "sharded_anti_entropy_step"]
+__all__ = [
+    "sharded_tree_root",
+    "sharded_divergence",
+    "sharded_anti_entropy_step",
+    "make_anti_entropy_step",
+]
 
 
 def _local_root(block: jax.Array) -> jax.Array:
     """[L, 8] -> [1, 8] subtree root (L is a power of two)."""
     return build_levels_device(block)[-1]
+
+
+def _check_local_block(l: int) -> None:
+    """Trace-time guard: per-shard leaf count must be a positive power of two,
+    or the local subtree reduction would apply odd-promotion at a shard
+    boundary and silently diverge from the global tree."""
+    if l == 0 or (l & (l - 1)):
+        raise ValueError(
+            f"per-shard leaf count {l} must be a positive power of two"
+        )
 
 
 def _check_shardable(n: int, d: int, what: str = "leaf count") -> int:
@@ -45,15 +60,8 @@ def _check_shardable(n: int, d: int, what: str = "leaf count") -> int:
     return l
 
 
-def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.Array:
-    """Root of the Merkle tree over [N, 8] leaf digests, keyspace-sharded.
-
-    N must equal mesh.shape[axis] * L with L a power of two (pad the
-    keyspace tensor to a bucket boundary before calling). Returns [8] uint32,
-    bit-identical to ``tree_root(leaves)``.
-    """
-    _check_shardable(leaves.shape[0], mesh.shape[axis])
-
+@lru_cache(maxsize=None)
+def _tree_root_program(mesh: Mesh, axis: str):
     @partial(
         shard_map,
         mesh=mesh,
@@ -62,11 +70,24 @@ def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.A
         check_vma=False,
     )
     def go(block):
+        _check_local_block(block.shape[0])
         local = _local_root(block)  # [1, 8]
         roots = jax.lax.all_gather(local, axis, axis=0, tiled=True)  # [D, 8]
         return build_levels_device(roots)[-1]  # [1, 8], same on every shard
 
-    return jax.jit(go)(leaves)[0]
+    return jax.jit(go)
+
+
+def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.Array:
+    """Root of the Merkle tree over [N, 8] leaf digests, keyspace-sharded.
+
+    N must equal mesh.shape[axis] * L with L a power of two (pad the
+    keyspace tensor to a bucket boundary before calling). Returns [8] uint32,
+    bit-identical to ``tree_root(leaves)``. The compiled SPMD program is
+    cached per (mesh, axis, shapes).
+    """
+    _check_shardable(leaves.shape[0], mesh.shape[axis])
+    return _tree_root_program(mesh, axis)(leaves)[0]
 
 
 def sharded_divergence(
@@ -81,10 +102,13 @@ def sharded_divergence(
     axis. Returns (masks [R, N] bool — sharded over keys, counts [R] int32 —
     global via psum, replicated).
     """
-    d = mesh.shape[axis]
-    if digests.shape[1] % d:
+    if digests.shape[1] % mesh.shape[axis]:
         raise ValueError("key axis not divisible by mesh")
+    return _divergence_program(mesh, axis)(digests, present)
 
+
+@lru_cache(maxsize=None)
+def _divergence_program(mesh: Mesh, axis: str):
     @partial(
         shard_map,
         mesh=mesh,
@@ -97,7 +121,7 @@ def sharded_divergence(
         counts = jax.lax.psum(jnp.sum(masks, axis=1, dtype=jnp.int32), axis)
         return masks, counts
 
-    return jax.jit(go)(digests, present)
+    return jax.jit(go)
 
 
 @lru_cache(maxsize=None)
@@ -108,7 +132,9 @@ def make_anti_entropy_step(mesh: Mesh, axis: str = "key"):
       1. hash every local (key, value) leaf — batched SHA-256 over the shard's
          padded block tensor;
       2. reduce the local leaves to one subtree root, all_gather the D subtree
-         roots over ICI, finish the tiny top tree on every shard;
+         roots over ICI, finish the tiny top tree on every shard (the
+         per-shard leaf count must be a positive power of two — enforced at
+         trace time);
       3. compare R replicas' digest blocks elementwise and psum the global
          per-replica divergence counts.
 
@@ -132,6 +158,7 @@ def make_anti_entropy_step(mesh: Mesh, axis: str = "key"):
         check_vma=False,
     )
     def step(blk, nb, dig, pres):
+        _check_local_block(blk.shape[0])
         leaves = sha256_blocks(blk, nb)
         local_root = _local_root(leaves)  # [1, 8]
         roots = jax.lax.all_gather(local_root, axis, axis=0, tiled=True)  # [D, 8]

@@ -1,0 +1,145 @@
+"""Native storage engines via ctypes: semantics, persistence, Merkle parity.
+
+Mirrors the reference's engine unit tests (rwlock_engine.rs:439-594,
+sled_engine.rs) plus cross-checks the native HASH/Merkle path against the
+Python CPU golden core.
+"""
+
+import tempfile
+import threading
+
+import pytest
+
+from merklekv_tpu.merkle import MerkleTree
+from merklekv_tpu.native_bindings import NativeEngine, NativeError
+
+
+@pytest.fixture
+def eng():
+    with NativeEngine("mem") as e:
+        yield e
+
+
+def test_basic_ops(eng):
+    assert eng.get(b"missing") is None
+    eng.set(b"a", b"1")
+    assert eng.get(b"a") == b"1"
+    assert eng.exists(b"a")
+    assert not eng.exists(b"b")
+    assert eng.dbsize() == 1
+    assert eng.delete(b"a")
+    assert not eng.delete(b"a")
+    assert eng.dbsize() == 0
+
+
+def test_values_with_spaces_tabs_unicode(eng):
+    eng.set(b"k", b"value with spaces\tand tabs")
+    assert eng.get(b"k") == b"value with spaces\tand tabs"
+    eng.set("clé".encode(), "välue☃".encode())
+    assert eng.get("clé".encode()) == "välue☃".encode()
+
+
+def test_numeric_semantics(eng):
+    # Missing key: created as the amount (reference rwlock_engine.rs:252-320).
+    assert eng.increment(b"n", 5) == 5
+    assert eng.increment(b"n", 1) == 6
+    assert eng.decrement(b"n", 10) == -4
+    assert eng.decrement(b"m", 3) == -3
+    eng.set(b"s", b"abc")
+    with pytest.raises(NativeError, match="not a valid number"):
+        eng.increment(b"s", 1)
+
+
+def test_append_prepend(eng):
+    assert eng.append(b"k", b"world") == b"world"  # create-if-missing
+    assert eng.prepend(b"k", b"hello ") == b"hello world"
+    assert eng.append(b"k", b"!") == b"hello world!"
+
+
+def test_scan_sorted_and_prefixed(eng):
+    for k in [b"b:2", b"a:1", b"b:1", b"c"]:
+        eng.set(k, b"x")
+    assert eng.scan() == [b"a:1", b"b:1", b"b:2", b"c"]
+    assert eng.scan(b"b:") == [b"b:1", b"b:2"]
+    assert eng.scan(b"zz") == []
+
+
+def test_truncate_and_memory(eng):
+    eng.set(b"k1", b"v1")
+    eng.set(b"k2", b"v2")
+    assert eng.memory_usage() == 8
+    eng.truncate()
+    assert eng.dbsize() == 0
+
+
+def test_snapshot_sorted(eng):
+    eng.set(b"z", b"3")
+    eng.set(b"a", b"1")
+    eng.set(b"m", b"2")
+    assert eng.snapshot() == [(b"a", b"1"), (b"m", b"2"), (b"z", b"3")]
+
+
+def test_merkle_root_matches_cpu_golden(eng):
+    items = [(f"key{i:03d}", f"val{i * 7}") for i in range(57)]
+    for k, v in items:
+        eng.set(k.encode(), v.encode())
+    expect = MerkleTree.from_items(items).root_hash()
+    assert eng.merkle_root() == expect
+
+
+def test_merkle_root_empty(eng):
+    assert eng.merkle_root() is None
+
+
+def test_concurrent_mixed_load(eng):
+    # Reference-style thread stress (rwlock_engine.rs:487-593).
+    def writer(tid):
+        for i in range(200):
+            eng.set(f"t{tid}:{i}".encode(), str(i).encode())
+
+    def reader():
+        for _ in range(200):
+            eng.get(b"t0:0")
+            eng.dbsize()
+
+    def bumper():
+        for _ in range(200):
+            eng.increment(b"shared", 1)
+
+    threads = (
+        [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+        + [threading.Thread(target=reader) for _ in range(2)]
+        + [threading.Thread(target=bumper) for _ in range(2)]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert eng.dbsize() == 4 * 200 + 1
+    assert eng.get(b"shared") == b"400"
+
+
+def test_log_engine_persistence():
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.set(b"persist", b"yes")
+            e.set(b"gone", b"x")
+            e.delete(b"gone")
+            e.increment(b"count", 7)
+            e.sync()
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"persist") == b"yes"
+            assert e2.get(b"gone") is None
+            assert e2.get(b"count") == b"7"
+            assert e2.dbsize() == 2
+
+
+def test_log_engine_truncate_persists():
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.set(b"a", b"1")
+            e.truncate()
+            e.set(b"b", b"2")
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"a") is None
+            assert e2.get(b"b") == b"2"

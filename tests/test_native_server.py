@@ -1,0 +1,319 @@
+"""Embedded native TCP server: protocol conformance + client SDK.
+
+Response shapes and error strings must match the reference surface
+(SURVEY.md §2.2; /root/reference/src/server.rs:547-924, protocol.rs:237-774).
+Runs the server in-process via ctypes on an ephemeral port.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient, ProtocolError
+from merklekv_tpu.merkle import MerkleTree
+from merklekv_tpu.native_bindings import (
+    OP_DEL,
+    OP_INCR,
+    OP_SET,
+    NativeEngine,
+    NativeServer,
+)
+
+
+@pytest.fixture
+def server():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, version="0.1.0")
+    srv.start()
+    yield srv
+    srv.close()
+    eng.close()
+
+
+@pytest.fixture
+def client(server):
+    c = MerkleKVClient("127.0.0.1", server.port).connect()
+    yield c
+    c.close()
+
+
+def raw(server, *lines) -> list[bytes]:
+    """Send raw lines on a fresh socket, return full response bytes."""
+    s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    out = []
+    for line in lines:
+        s.sendall(line)
+        chunks = b""
+        s.settimeout(0.5)
+        try:
+            while True:
+                d = s.recv(65536)
+                if not d:
+                    break
+                chunks += d
+        except socket.timeout:
+            pass
+        out.append(chunks)
+    s.close()
+    return out
+
+
+# ------------------------------------------------------------ basic ops
+
+def test_set_get_delete(client):
+    assert client.set("k", "v")
+    assert client.get("k") == "v"
+    assert client.delete("k") is True
+    assert client.delete("k") is False
+    assert client.get("k") is None
+
+
+def test_values_with_spaces(client):
+    client.set("k", "a value with spaces")
+    assert client.get("k") == "a value with spaces"
+
+
+def test_empty_value_rejected_by_framing(client):
+    # "SET k " trims to "SET k" (reference input.trim(), protocol.rs:238):
+    # an empty value cannot be expressed on the wire.
+    with pytest.raises(ProtocolError, match="requires a key and value"):
+        client.set("k", "")
+
+
+def test_numeric(client):
+    assert client.increment("n") == 1
+    assert client.increment("n", 10) == 11
+    assert client.decrement("n", 5) == 6
+    assert client.decrement("new") == -1
+    client.set("s", "xyz")
+    with pytest.raises(ProtocolError, match="not a valid number"):
+        client.increment("s")
+
+
+def test_append_prepend(client):
+    assert client.append("greet", "world") == "world"
+    # Trailing whitespace in a value is trimmed by framing; inner is kept.
+    assert client.prepend("greet", "hello") == "helloworld"
+    assert client.append("greet", "and more") == "helloworldand more"
+    # An empty value is unexpressible on the wire (trimmed away), so the
+    # server.rs:772-779 empty-value branch surfaces as a parse error.
+    with pytest.raises(ProtocolError, match="requires a key and value"):
+        client.append("nope", "")
+
+
+def test_bulk(client):
+    client.mset({"a": "1", "b": "2", "c": "3"})
+    got = client.mget(["a", "b", "missing"])
+    assert got == {"a": "1", "b": "2", "missing": None}
+    assert client.mget(["m1", "m2"]) == {"m1": None, "m2": None}
+    assert client.truncate()
+    assert client.dbsize() == 0
+
+
+def test_query(client):
+    client.mset({"user:1": "a", "user:2": "b", "other": "c"})
+    assert client.exists("user:1", "other", "nope") == 2
+    assert client.scan("user:") == ["user:1", "user:2"]
+    assert client.scan() == ["other", "user:1", "user:2"]
+    assert client.dbsize() == 3
+
+
+def test_hash_parity_with_python_merkle(client):
+    items = [(f"hk{i}", f"hv{i * 3}") for i in range(23)]
+    for k, v in items:
+        client.set(k, v)
+    assert client.hash() == MerkleTree.from_items(items).root_hex()
+    # Prefix pattern
+    sub = [(k, v) for k, v in items if k.startswith("hk1")]
+    assert client.hash("hk1") == MerkleTree.from_items(sub).root_hex()
+    # '*' = all keys
+    assert client.hash("*") == client.hash()
+
+
+def test_hash_empty_is_64_zeros(client):
+    assert client.hash() == "0" * 64
+
+
+def test_admin(client):
+    assert client.ping() == "PONG "
+    assert client.ping("hello") == "PONG hello"
+    assert client.echo("hi there") == "hi there"
+    assert client.version() == "0.1.0"
+    client.set("k", "v")
+    assert client.memory() == 2
+    info = client.info()
+    assert info["version"] == "0.1.0"
+    assert info["db_keys"] == "1"
+    stats = client.stats()
+    assert int(stats["set_commands"]) >= 1
+    assert int(stats["total_commands"]) >= 1
+    assert "used_memory_kb" in stats
+    rows = client.client_list()
+    assert len(rows) == 1 and "addr" in rows[0]
+    assert client.flushdb()
+    assert client.dbsize() == 0
+
+
+def test_stats_counter_mapping(client):
+    client.ping()
+    client.flushdb()
+    stats = client.stats()
+    # Reference quirk parity: FLUSHDB counts as management (server.rs:255-262).
+    assert stats["flushdb_commands"] == "0"
+    assert int(stats["management_commands"]) >= 1
+    assert int(stats["ping_commands"]) >= 1
+
+
+def test_replicate_defaults(client):
+    assert client.replicate("status") == "REPLICATION disabled"
+    with pytest.raises(ProtocolError, match="replication not configured"):
+        client.replicate("enable")
+
+
+# ------------------------------------------------------------ raw protocol
+
+@pytest.mark.parametrize(
+    "line,expect",
+    [
+        (b"GET\r\n", b"ERROR GET command requires arguments\r\n"),
+        (b"GET a b\r\n", b"ERROR GET command accepts only one argument\r\n"),
+        (b"SET k\r\n", b"ERROR SET command requires a key and value\r\n"),
+        (b"SET  v\r\n", b"ERROR SET command key cannot be empty\r\n"),
+        (b"DEL\r\n", b"ERROR DEL command requires arguments\r\n"),
+        (b"DBSIZE x\r\n", b"ERROR DBSIZE command does not accept any arguments\r\n"),
+        (b"ECHO\r\n", b"ERROR ECHO command requires arguments\r\n"),
+        (b"INC 5\r\n", b"ERROR INC command requires a key\r\n"),
+        (b"INC k abc\r\n", b"ERROR INC command amount must be a valid number\r\n"),
+        (b"MSET a\r\n",
+         b"ERROR MSET command requires an even number of arguments (key-value pairs)\r\n"),
+        (b"GET k\tx\r\n",
+         b"ERROR Invalid character: tab character not allowed in key\r\n"),
+        (b"NOSUCH\r\n", b"ERROR Unknown command: NOSUCH\r\n"),
+        (b"NOSUCH args\r\n", b"ERROR Unknown command: NOSUCH\r\n"),
+        (b"REPLICATE bogus\r\n", b"ERROR Unknown REPLICATE action: bogus\r\n"),
+        (b"SYNC h notaport\r\n",
+         b"ERROR Invalid port: must be an integer in 0..=65535\r\n"),
+        (b"CLIENT FOO\r\n", b"ERROR Unknown CLIENT subcommand\r\n"),
+        (b"\r\n", b"ERROR Empty command\r\n"),
+        (b"get lowercase_missing\r\n", b"NOT_FOUND\r\n"),
+    ],
+)
+def test_error_messages(server, line, expect):
+    assert raw(server, line)[0] == expect
+
+
+def test_set_preserves_inner_spaces(server):
+    out = raw(server, b"SET k  leading\r\n", b"GET k\r\n")
+    assert out[0] == b"OK\r\n"
+    assert out[1] == b"VALUE  leading\r\n"  # value is " leading"
+
+
+def test_tab_allowed_in_value(server):
+    out = raw(server, b"SET k a\tb\r\n", b"GET k\r\n")
+    assert out[0] == b"OK\r\n"
+    assert out[1] == b"VALUE a\tb\r\n"
+
+
+def test_line_too_long_closes_connection(server):
+    big = b"SET k " + b"x" * (1024 * 1024 + 16) + b"\r\n"
+    out = raw(server, big)
+    assert out[0] == b"ERROR line too long\r\n"
+
+
+def test_large_value_roundtrip(server):
+    v = b"y" * (512 * 1024)
+    out = raw(server, b"SET big " + v + b"\r\n", b"GET big\r\n")
+    assert out[0] == b"OK\r\n"
+    assert out[1] == b"VALUE " + v + b"\r\n"
+
+
+def test_pipelined_commands_one_packet(server):
+    out = raw(server, b"SET a 1\r\nSET b 2\r\nGET a\r\nGET b\r\n")
+    assert out[0] == b"OK\r\nOK\r\nVALUE 1\r\nVALUE 2\r\n"
+
+
+# ------------------------------------------------------------ events
+
+def test_change_events_drained(server, client):
+    client.set("k1", "v1")
+    client.increment("n", 2)
+    client.delete("k1")
+    evs = server.drain_events()
+    assert [(e.op, e.key) for e in evs] == [
+        (OP_SET, b"k1"),
+        (OP_INCR, b"n"),
+        (OP_DEL, b"k1"),
+    ]
+    assert evs[1].value == b"2"  # post-op value
+    assert not evs[2].has_value
+    assert evs[0].seq < evs[1].seq < evs[2].seq
+    assert server.drain_events() == []
+
+
+# ------------------------------------------------------------ concurrency
+
+def test_many_concurrent_clients(server):
+    errors = []
+
+    def worker(tid):
+        try:
+            with MerkleKVClient("127.0.0.1", server.port) as c:
+                for i in range(50):
+                    c.set(f"c{tid}:{i}", str(i))
+                    assert c.get(f"c{tid}:{i}") == str(i)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(20)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    with MerkleKVClient("127.0.0.1", server.port) as c:
+        assert c.dbsize() == 20 * 50
+
+
+def test_cluster_callback_routes_sync(server):
+    seen = []
+
+    def handler(line):
+        seen.append(line)
+        return "OK\r\n" if line.startswith("SYNC") else None
+
+    server.set_cluster_handler(handler)
+    with MerkleKVClient("127.0.0.1", server.port) as c:
+        assert c.sync_with("peer.example", 7380, full=True)
+    assert seen == ["SYNC peer.example 7380 --full"]
+
+
+def test_shutdown_stops_embedded_server(server):
+    with MerkleKVClient("127.0.0.1", server.port) as c:
+        c.shutdown()
+    import time
+
+    for _ in range(100):
+        if server.stopping:
+            break
+        time.sleep(0.01)
+    assert server.stopping
+
+
+# ------------------------------------------------------------ async client
+
+def test_async_client(server):
+    import asyncio
+
+    from merklekv_tpu.client import AsyncMerkleKVClient
+
+    async def go():
+        async with AsyncMerkleKVClient("127.0.0.1", server.port) as c:
+            await c.set("ak", "av")
+            assert await c.get("ak") == "av"
+            assert await c.increment("an", 4) == 4
+            assert await c.scan("a") == ["ak", "an"]
+            assert await c.health_check()
+            assert await c.pipeline(["SET p 1", "GET p"]) == ["OK", "VALUE 1"]
+
+    asyncio.run(go())
