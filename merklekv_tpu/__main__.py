@@ -43,11 +43,6 @@ def main(argv: list[str] | None = None) -> int:
         engine, cfg.host, cfg.port, version=__version__, exit_on_shutdown=False
     )
     server.start()
-    print(
-        f"merklekv_tpu listening on {cfg.host}:{server.port} "
-        f"(engine={cfg.engine})",
-        flush=True,
-    )
 
     node = None
     if cfg.replication.enabled or cfg.anti_entropy.enabled:
@@ -55,6 +50,15 @@ def main(argv: list[str] | None = None) -> int:
 
         node = ClusterNode(cfg, engine, server)
         node.start()
+
+    # Readiness line LAST: spawning harnesses treat it as "fully up",
+    # including the replication subscription (QoS-0 — a publish before the
+    # peer subscribes is lost until anti-entropy repairs it).
+    print(
+        f"merklekv_tpu listening on {cfg.host}:{server.port} "
+        f"(engine={cfg.engine})",
+        flush=True,
+    )
 
     stop = {"flag": False}
 

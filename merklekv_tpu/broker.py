@@ -1,0 +1,34 @@
+"""Standalone replication broker: `python -m merklekv_tpu.broker --port 1883`.
+
+Self-hosted stand-in for the external MQTT broker the reference depends on
+(test.mosquitto.org, /root/reference/README.md:56). Speaks the length-framed
+fan-out protocol of merklekv_tpu.cluster.transport.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="merklekv_tpu.broker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1883)
+    args = p.parse_args(argv)
+
+    from merklekv_tpu.cluster.transport import TcpBroker
+
+    broker = TcpBroker(args.host, args.port)
+    print(f"merklekv broker listening on {broker.host}:{broker.port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        broker.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""Cluster control plane: replication, change events, anti-entropy.
+
+Host-side subsystems around the native server and the TPU Merkle data plane:
+
+- ``change_event``: canonical replication record + CBOR/binary/JSON codecs
+  (reference /root/reference/src/change_event.rs)
+- ``applier``: pure LWW + idempotency application logic
+  (reference replication.rs:272-318 and the LocalApplier test double)
+- ``transport``: pub/sub event fabric — in-process bus and a TCP broker
+  (reference: external MQTT broker, replication.rs:115-143)
+- ``replicator``: drains native write events, publishes, applies remote
+- ``sync``: anti-entropy manager — batched snapshot exchange + TPU diff
+  (reference sync.rs, minus its per-key-TCP-connection hot loop)
+- ``node``: wires everything to a running native server
+"""
+
+from merklekv_tpu.cluster.change_event import (
+    ChangeEvent,
+    OpKind,
+    decode_any,
+    decode_cbor,
+    decode_binary,
+    decode_json,
+    encode_cbor,
+    encode_binary,
+    encode_json,
+)
+from merklekv_tpu.cluster.applier import LWWApplier
+
+__all__ = [
+    "ChangeEvent",
+    "OpKind",
+    "LWWApplier",
+    "decode_any",
+    "decode_cbor",
+    "decode_binary",
+    "decode_json",
+    "encode_cbor",
+    "encode_binary",
+    "encode_json",
+]

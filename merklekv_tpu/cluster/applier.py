@@ -1,0 +1,79 @@
+"""Pure LWW + idempotency application logic.
+
+Mirrors the reference subscriber's rules (replication.rs:272-318) with the
+deterministic tie-break from its LocalApplier double (change_event.rs:222-246):
+  - drop events whose op_id was already applied (idempotency under QoS-1
+    at-least-once delivery);
+  - drop events older than the key's last applied ts (LWW);
+  - on a ts tie, keep the lexicographically larger op_id (total order);
+  - Del removes, everything else writes the post-op value.
+
+Improvements over the reference: the reference's `seen`/`last_ts` maps grow
+without bound and die with the process (replication.rs:277-278 TODO); here
+the dedupe set is LRU-bounded.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind
+
+__all__ = ["LWWApplier"]
+
+
+class LWWApplier:
+    """Applies ChangeEvents onto set/delete callables (engine-agnostic)."""
+
+    def __init__(
+        self,
+        set_fn: Callable[[bytes, bytes], None],
+        del_fn: Callable[[bytes], None],
+        max_seen: int = 1 << 20,
+    ) -> None:
+        self._set = set_fn
+        self._del = del_fn
+        self._seen: OrderedDict[bytes, None] = OrderedDict()
+        self._max_seen = max_seen
+        self._last_ts: dict[str, int] = {}
+        self._last_op_id: dict[str, bytes] = {}
+        self.applied = 0
+        self.skipped_dup = 0
+        self.skipped_lww = 0
+
+    def apply(self, ev: ChangeEvent) -> bool:
+        """Apply one event; returns True if state changed."""
+        if ev.op_id in self._seen:
+            self.skipped_dup += 1
+            return False
+        last_ts = self._last_ts.get(ev.key, 0)
+        if ev.ts < last_ts:
+            self._remember(ev.op_id)
+            self.skipped_lww += 1
+            return False
+        if ev.ts == last_ts and ev.op_id < self._last_op_id.get(ev.key, b"\0" * 16):
+            self._remember(ev.op_id)
+            self.skipped_lww += 1
+            return False
+
+        key = ev.key.encode("utf-8")
+        if ev.op is OpKind.DEL:
+            self._del(key)
+        elif ev.val is not None:
+            # Post-op value semantics: INCR/DECR/APPEND/PREPEND all apply as
+            # an absolute SET of the result (change_event.rs:17-19).
+            self._set(key, ev.val)
+        self._last_ts[ev.key] = ev.ts
+        self._last_op_id[ev.key] = ev.op_id
+        self._remember(ev.op_id)
+        self.applied += 1
+        return True
+
+    def _remember(self, op_id: bytes) -> None:
+        self._seen[op_id] = None
+        if len(self._seen) > self._max_seen:
+            self._seen.popitem(last=False)
+
+    def last_ts(self, key: str) -> Optional[int]:
+        return self._last_ts.get(key)

@@ -1,0 +1,310 @@
+"""Canonical replication record + wire codecs.
+
+Schema matches the reference ChangeEvent
+(/root/reference/src/change_event.rs:59-79):
+  {v: u16, op, key: str, val: Optional[bytes], ts: u64 (ns), src: str,
+   op_id: 16 bytes (uuid4), prev: Optional[32 bytes], ttl: Optional[u64]}
+`val` carries the POST-OP result so application is idempotent
+(change_event.rs:17-19).
+
+Codecs (change_event.rs:127-172 analog): CBOR is the wire default; a compact
+length-prefixed binary format stands in for bincode; JSON (base64 for bytes)
+for debuggability. ``decode_any`` tries CBOR -> binary -> JSON. The CBOR
+encoder below emits standard definite-length RFC 8949 items (maps with text
+keys, uints, byte/text strings, null), so third-party CBOR tooling can read
+events off the wire.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+__all__ = [
+    "OpKind",
+    "ChangeEvent",
+    "encode_cbor",
+    "decode_cbor",
+    "encode_binary",
+    "decode_binary",
+    "encode_json",
+    "decode_json",
+    "decode_any",
+]
+
+
+class OpKind(str, Enum):
+    SET = "set"
+    DEL = "del"
+    INCR = "incr"
+    DECR = "decr"
+    APPEND = "append"
+    PREPEND = "prepend"
+
+
+@dataclass
+class ChangeEvent:
+    op: OpKind
+    key: str
+    val: Optional[bytes]  # post-op value; None for deletions
+    ts: int  # unix nanoseconds (or logical clock); only ordering matters
+    src: str  # originating node id (loop prevention)
+    op_id: bytes = field(default_factory=lambda: uuid.uuid4().bytes)
+    v: int = 1
+    prev: Optional[bytes] = None  # optional 32-byte Merkle hash
+    ttl: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if len(self.op_id) != 16:
+            raise ValueError("op_id must be 16 bytes")
+        if self.prev is not None and len(self.prev) != 32:
+            raise ValueError("prev must be 32 bytes")
+
+    @classmethod
+    def new(
+        cls,
+        op: OpKind,
+        key: str,
+        val: Optional[bytes],
+        src: str,
+        ts: Optional[int] = None,
+    ) -> "ChangeEvent":
+        return cls(op=op, key=key, val=val, src=src,
+                   ts=time.time_ns() if ts is None else ts)
+
+
+# ------------------------------------------------------------------ CBOR
+
+def _cbor_head(major: int, arg: int) -> bytes:
+    if arg < 24:
+        return bytes([(major << 5) | arg])
+    if arg < 0x100:
+        return bytes([(major << 5) | 24, arg])
+    if arg < 0x10000:
+        return bytes([(major << 5) | 25]) + struct.pack(">H", arg)
+    if arg < 0x100000000:
+        return bytes([(major << 5) | 26]) + struct.pack(">I", arg)
+    return bytes([(major << 5) | 27]) + struct.pack(">Q", arg)
+
+
+def _cbor_uint(v: int) -> bytes:
+    return _cbor_head(0, v)
+
+
+def _cbor_bytes(b: bytes) -> bytes:
+    return _cbor_head(2, len(b)) + b
+
+
+def _cbor_text(s: str) -> bytes:
+    e = s.encode("utf-8")
+    return _cbor_head(3, len(e)) + e
+
+
+_CBOR_NULL = b"\xf6"
+
+
+def encode_cbor(ev: ChangeEvent) -> bytes:
+    pairs = [
+        (b"\x61v", _cbor_uint(ev.v)),
+        (b"\x62op", _cbor_text(ev.op.value)),
+        (b"\x63key", _cbor_text(ev.key)),
+        (b"\x63val", _CBOR_NULL if ev.val is None else _cbor_bytes(ev.val)),
+        (b"\x62ts", _cbor_uint(ev.ts)),
+        (b"\x63src", _cbor_text(ev.src)),
+        (b"\x65op_id", _cbor_bytes(ev.op_id)),
+        (b"\x64prev", _CBOR_NULL if ev.prev is None else _cbor_bytes(ev.prev)),
+        (b"\x63ttl", _CBOR_NULL if ev.ttl is None else _cbor_uint(ev.ttl)),
+    ]
+    out = _cbor_head(5, len(pairs))
+    for k, v in pairs:
+        out += k + v
+    return out
+
+
+class _CborReader:
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise ValueError("truncated CBOR")
+        b = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return b
+
+    def _head(self) -> tuple[int, int]:
+        b = self._take(1)[0]
+        major, info = b >> 5, b & 0x1F
+        if info < 24:
+            return major, info
+        if info == 24:
+            return major, self._take(1)[0]
+        if info == 25:
+            return major, struct.unpack(">H", self._take(2))[0]
+        if info == 26:
+            return major, struct.unpack(">I", self._take(4))[0]
+        if info == 27:
+            return major, struct.unpack(">Q", self._take(8))[0]
+        raise ValueError(f"unsupported CBOR info {info}")
+
+    def item(self):
+        start = self.pos
+        b = self.data[self.pos] if self.pos < len(self.data) else None
+        if b is None:
+            raise ValueError("truncated CBOR")
+        if b == 0xF6:  # null
+            self.pos += 1
+            return None
+        if b == 0xF4:
+            self.pos += 1
+            return False
+        if b == 0xF5:
+            self.pos += 1
+            return True
+        major, arg = self._head()
+        if major == 0:
+            return arg
+        if major == 1:
+            return -1 - arg
+        if major == 2:
+            return self._take(arg)
+        if major == 3:
+            return self._take(arg).decode("utf-8")
+        if major == 4:
+            return [self.item() for _ in range(arg)]
+        if major == 5:
+            return {self.item(): self.item() for _ in range(arg)}
+        raise ValueError(f"unsupported CBOR major {major} at {start}")
+
+
+def decode_cbor(data: bytes) -> ChangeEvent:
+    reader = _CborReader(data)
+    m = reader.item()
+    if not isinstance(m, dict):
+        raise ValueError("CBOR event must be a map")
+    return _from_map(m)
+
+
+def _from_map(m: dict) -> ChangeEvent:
+    try:
+        return ChangeEvent(
+            v=int(m["v"]),
+            op=OpKind(m["op"]),
+            key=m["key"],
+            val=m["val"],
+            ts=int(m["ts"]),
+            src=m["src"],
+            op_id=bytes(m["op_id"]),
+            prev=None if m.get("prev") is None else bytes(m["prev"]),
+            ttl=None if m.get("ttl") is None else int(m["ttl"]),
+        )
+    except (KeyError, TypeError) as e:
+        raise ValueError(f"malformed event map: {e}") from e
+
+
+# ---------------------------------------------------------------- binary
+
+_BIN_MAGIC = b"MKB1"
+
+
+def encode_binary(ev: ChangeEvent) -> bytes:
+    """Compact fixed-order binary codec (bincode-role analog)."""
+    key = ev.key.encode("utf-8")
+    src = ev.src.encode("utf-8")
+    out = bytearray(_BIN_MAGIC)
+    op_code = list(OpKind).index(ev.op)
+    out += struct.pack("<HBQ", ev.v, op_code, ev.ts)
+    out += struct.pack("<I", len(key)) + key
+    out += struct.pack("<I", len(src)) + src
+    out += ev.op_id
+    if ev.val is None:
+        out += b"\x00"
+    else:
+        out += b"\x01" + struct.pack("<I", len(ev.val)) + ev.val
+    out += b"\x00" if ev.prev is None else b"\x01" + ev.prev
+    out += b"\x00" if ev.ttl is None else b"\x01" + struct.pack("<Q", ev.ttl)
+    return bytes(out)
+
+
+def decode_binary(data: bytes) -> ChangeEvent:
+    if data[:4] != _BIN_MAGIC:
+        raise ValueError("bad magic")
+    pos = 4
+
+    def take(n: int) -> bytes:
+        nonlocal pos
+        if pos + n > len(data):
+            raise ValueError("truncated binary event")
+        b = data[pos : pos + n]
+        pos += n
+        return b
+
+    v, op_code, ts = struct.unpack("<HBQ", take(11))
+    (klen,) = struct.unpack("<I", take(4))
+    key = take(klen).decode("utf-8")
+    (slen,) = struct.unpack("<I", take(4))
+    src = take(slen).decode("utf-8")
+    op_id = take(16)
+    val = None
+    if take(1) == b"\x01":
+        (vlen,) = struct.unpack("<I", take(4))
+        val = take(vlen)
+    prev = take(32) if take(1) == b"\x01" else None
+    ttl = struct.unpack("<Q", take(8))[0] if take(1) == b"\x01" else None
+    return ChangeEvent(v=v, op=list(OpKind)[op_code], key=key, val=val,
+                       ts=ts, src=src, op_id=op_id, prev=prev, ttl=ttl)
+
+
+# ------------------------------------------------------------------ JSON
+
+def encode_json(ev: ChangeEvent) -> bytes:
+    def b64(b: Optional[bytes]):
+        return None if b is None else base64.b64encode(b).decode()
+
+    return json.dumps(
+        {
+            "v": ev.v,
+            "op": ev.op.value,
+            "key": ev.key,
+            "val": b64(ev.val),
+            "ts": ev.ts,
+            "src": ev.src,
+            "op_id": b64(ev.op_id),
+            "prev": b64(ev.prev),
+            "ttl": ev.ttl,
+        },
+        separators=(",", ":"),
+    ).encode("utf-8")
+
+
+def decode_json(data: bytes) -> ChangeEvent:
+    m = json.loads(data.decode("utf-8"))
+    if not isinstance(m, dict):
+        raise ValueError("JSON event must be an object")
+
+    def u64(x):
+        return None if x is None else base64.b64decode(x)
+
+    m = dict(m)
+    m["val"] = u64(m.get("val"))
+    m["op_id"] = u64(m.get("op_id"))
+    m["prev"] = u64(m.get("prev"))
+    return _from_map(m)
+
+
+def decode_any(data: bytes) -> ChangeEvent:
+    """CBOR -> binary -> JSON, like the reference's decode_any
+    (change_event.rs:159-172)."""
+    for dec in (decode_cbor, decode_binary, decode_json):
+        try:
+            return dec(data)
+        except Exception:
+            continue
+    raise ValueError("undecodable change event")

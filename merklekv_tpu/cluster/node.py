@@ -1,0 +1,126 @@
+"""ClusterNode: wires replication + anti-entropy to a running native server.
+
+Owns the SYNC / REPLICATE cluster-command callback (the native server
+delegates those verbs here), the Replicator lifecycle (REPLICATE
+enable/disable/status, reference server.rs:686-720), and the periodic
+anti-entropy loop.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Optional
+
+from merklekv_tpu.cluster.replicator import Replicator
+from merklekv_tpu.cluster.sync import SyncManager
+from merklekv_tpu.cluster.transport import Transport, make_transport
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+__all__ = ["ClusterNode"]
+
+
+class ClusterNode:
+    def __init__(
+        self,
+        cfg: Config,
+        engine: NativeEngine,
+        server: NativeServer,
+        transport: Optional[Transport] = None,
+    ) -> None:
+        self._cfg = cfg
+        self._engine = engine
+        self._server = server
+        self._transport = transport
+        self._owns_transport = transport is None
+        self._replicator: Optional[Replicator] = None
+        self._rep_mu = threading.Lock()
+        self.sync_manager = SyncManager(engine, device=cfg.anti_entropy.engine)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._server.set_cluster_handler(self._on_cluster_command)
+        if self._cfg.replication.enabled:
+            err = self._enable_replication()
+            if err is not None:
+                print(f"replication not started: {err}", file=sys.stderr,
+                      flush=True)
+        if self._cfg.anti_entropy.enabled and self._cfg.anti_entropy.peers:
+            self.sync_manager.start_loop(
+                self._cfg.anti_entropy.peers,
+                self._cfg.anti_entropy.interval_seconds,
+            )
+
+    def stop(self) -> None:
+        self.sync_manager.stop()
+        with self._rep_mu:
+            if self._replicator is not None:
+                self._replicator.stop()
+                self._replicator = None
+        if self._owns_transport and self._transport is not None:
+            self._transport.close()
+            self._transport = None
+        self._server.set_cluster_handler(None)
+
+    @property
+    def replicator(self) -> Optional[Replicator]:
+        return self._replicator
+
+    # -- replication management ---------------------------------------------
+    def _get_transport(self) -> Transport:
+        if self._transport is None:
+            rep = self._cfg.replication
+            self._transport = make_transport(rep.mqtt_broker, rep.mqtt_port)
+        return self._transport
+
+    def _enable_replication(self) -> Optional[str]:
+        with self._rep_mu:
+            if self._replicator is not None:
+                return None  # already enabled
+            try:
+                transport = self._get_transport()
+            except OSError as e:
+                return f"broker unreachable: {e}"
+            self._replicator = Replicator(
+                self._engine,
+                self._server,
+                transport,
+                topic_prefix=self._cfg.replication.topic_prefix,
+                node_id=self._cfg.replication.client_id,
+            )
+            self._replicator.start()
+            return None
+
+    def _disable_replication(self) -> None:
+        with self._rep_mu:
+            if self._replicator is not None:
+                self._replicator.stop()
+                self._replicator = None
+
+    # -- cluster command callback ---------------------------------------------
+    def _on_cluster_command(self, line: str) -> Optional[str]:
+        parts = line.split()
+        if parts[0] == "SYNC":
+            host, port = parts[1], int(parts[2])
+            try:
+                self.sync_manager.sync_once(host, port)
+                return "OK\r\n"
+            except Exception as e:
+                return f"ERROR {e}\r\n"
+        if parts[0] == "REPLICATE":
+            action = parts[1]
+            if action == "enable":
+                err = self._enable_replication()
+                return "OK\r\n" if err is None else f"ERROR {err}\r\n"
+            if action == "disable":
+                self._disable_replication()
+                return "OK\r\n"
+            if action == "status":
+                with self._rep_mu:
+                    enabled = self._replicator is not None
+                if enabled:
+                    n = len(self._cfg.replication.peer_list)
+                    return f"REPLICATION enabled {n} nodes\r\n"
+                return "REPLICATION disabled\r\n"
+        return None

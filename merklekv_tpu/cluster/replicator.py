@@ -1,0 +1,150 @@
+"""Replicator: native write events out, remote events applied in.
+
+Reference analog: /root/reference/src/replication.rs — publish every
+successful local write as a ChangeEvent on "{prefix}/events" (QoS-1 there,
+QoS-0 here with anti-entropy as the repair path), subscribe and apply remote
+events with loop prevention (src), idempotency (op_id), and per-key LWW.
+
+Differences by design:
+  - local writes are staged by the NATIVE server into an EventQueue
+    (merklekv_tpu/native/events.h); a drain thread batches them out instead
+    of awaiting an MQTT publish inside the request path (reference
+    server.rs:925-938 holds the replicator lock per command);
+  - applied remote writes go straight to the shared native engine, so they
+    do NOT re-enter the server's event queue — no echo loop;
+  - the drained batches also feed the TPU incremental Merkle path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from merklekv_tpu.cluster.applier import LWWApplier
+from merklekv_tpu.cluster.change_event import (
+    ChangeEvent,
+    OpKind,
+    decode_any,
+    encode_cbor,
+)
+from merklekv_tpu.cluster.transport import Transport
+from merklekv_tpu.native_bindings import (
+    OP_APPEND,
+    OP_DECR,
+    OP_DEL,
+    OP_INCR,
+    OP_PREPEND,
+    OP_SET,
+    ChangeEventRaw,
+    NativeEngine,
+    NativeServer,
+)
+
+__all__ = ["Replicator"]
+
+_OP_MAP = {
+    OP_SET: OpKind.SET,
+    OP_DEL: OpKind.DEL,
+    OP_INCR: OpKind.INCR,
+    OP_DECR: OpKind.DECR,
+    OP_APPEND: OpKind.APPEND,
+    OP_PREPEND: OpKind.PREPEND,
+}
+
+
+class Replicator:
+    def __init__(
+        self,
+        engine: NativeEngine,
+        server: NativeServer,
+        transport: Transport,
+        topic_prefix: str = "merkle_kv",
+        node_id: str = "",
+        drain_interval: float = 0.005,
+        batch_listener: Optional[Callable[[list[ChangeEvent]], None]] = None,
+    ) -> None:
+        self._engine = engine
+        self._server = server
+        self._transport = transport
+        self._topic = f"{topic_prefix}/events"
+        self.node_id = node_id or f"node-{uuid.uuid4().hex[:12]}"
+        self._drain_interval = drain_interval
+        self._batch_listener = batch_listener
+        self._applier = LWWApplier(engine.set, lambda k: engine.delete(k))
+        self._applier_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._drain_thread: Optional[threading.Thread] = None
+        self.published = 0
+        self.received = 0
+        self.decode_errors = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._server.enable_events(True)
+        self._transport.subscribe(self._topic, self._on_message)
+        self._drain_thread = threading.Thread(
+            target=self._drain_loop, daemon=True, name="mkv-replicator-drain"
+        )
+        self._drain_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._drain_thread is not None:
+            self._drain_thread.join(timeout=5)
+            self._drain_thread = None
+        self._server.enable_events(False)
+        self.flush()
+        self._transport.unsubscribe(self._on_message)
+
+    # -- outbound -----------------------------------------------------------
+    def flush(self) -> int:
+        """Drain and publish pending native write events once."""
+        raws = self._server.drain_events()
+        if not raws:
+            return 0
+        events = [self._to_event(r) for r in raws]
+        for ev in events:
+            self._transport.publish(self._topic, encode_cbor(ev))
+        self.published += len(events)
+        if self._batch_listener is not None:
+            try:
+                self._batch_listener(events)
+            except Exception:
+                pass
+        return len(events)
+
+    def _drain_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.flush() == 0:
+                time.sleep(self._drain_interval)
+
+    def _to_event(self, raw: ChangeEventRaw) -> ChangeEvent:
+        return ChangeEvent(
+            op=_OP_MAP[raw.op],
+            key=raw.key.decode("utf-8", "surrogateescape"),
+            val=raw.value if raw.has_value else None,
+            ts=raw.ts_ns,
+            src=self.node_id,
+        )
+
+    # -- inbound ------------------------------------------------------------
+    def _on_message(self, topic: str, payload: bytes) -> None:
+        try:
+            ev = decode_any(payload)
+        except ValueError:
+            # Malformed messages are tolerated, like the reference's decoder
+            # fallthrough (replication.rs:150-157).
+            self.decode_errors += 1
+            return
+        if ev.src == self.node_id:
+            return  # loop prevention
+        self.received += 1
+        with self._applier_mu:
+            self._applier.apply(ev)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def applier(self) -> LWWApplier:
+        return self._applier

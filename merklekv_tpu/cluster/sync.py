@@ -1,0 +1,171 @@
+"""Anti-entropy: make the local store converge to a remote peer.
+
+Reference analog: /root/reference/src/sync.rs. Its hot loops are pathological:
+snapshotting rebuilds the Merkle tree per insert (O(n^2 log n) hashing,
+sync.rs:104-119) and every remote key is GET over a FRESH TCP connection
+(sync.rs:192-214). Here:
+
+  - the local snapshot is one native-engine export (sorted, no hashing on
+    insert);
+  - the remote snapshot is one connection: SCAN + batched MGET;
+  - leaf hashing is batched — hashlib for small keyspaces, the TPU engine
+    (one vmapped SHA-256 program) beyond a threshold;
+  - the diff is the device multi-replica comparison (merkle/diff.py);
+  - the periodic loop is actually wired (the reference's start_sync_loop is
+    dead code, sync.rs:90-99).
+
+Semantics match sync_once: one-way local := remote for every divergent key
+(sync.rs:74-83), including deletion of local-only keys.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.merkle.encoding import leaf_hash
+from merklekv_tpu.native_bindings import NativeEngine
+
+__all__ = ["SyncManager", "SyncReport"]
+
+# Below this many union keys the device round-trip costs more than hashlib.
+_DEVICE_THRESHOLD = 4096
+
+
+@dataclass
+class SyncReport:
+    peer: str = ""
+    remote_keys: int = 0
+    local_keys: int = 0
+    divergent: int = 0
+    set_keys: int = 0
+    deleted_keys: int = 0
+    seconds: float = 0.0
+    details: list[str] = field(default_factory=list)
+
+
+def _leaf_map_device(items: list[tuple[bytes, bytes]]) -> dict[bytes, bytes]:
+    from merklekv_tpu.merkle.jax_engine import leaf_digests
+    from merklekv_tpu.ops.sha256 import digests_to_bytes
+
+    import numpy as np
+
+    digests = leaf_digests([k for k, _ in items], [v for _, v in items])
+    return dict(zip((k for k, _ in items), digests_to_bytes(np.asarray(digests))))
+
+
+def _leaf_map(items: list[tuple[bytes, bytes]], use_device: bool) -> dict[bytes, bytes]:
+    if use_device:
+        return _leaf_map_device(items)
+    return {k: leaf_hash(k, v) for k, v in items}
+
+
+class SyncManager:
+    def __init__(
+        self,
+        engine: NativeEngine,
+        device: str = "auto",  # "auto" | "cpu" | "tpu"
+        mget_batch: int = 512,
+        timeout: float = 30.0,
+    ) -> None:
+        self._engine = engine
+        self._device = device
+        self._mget_batch = mget_batch
+        self._timeout = timeout
+        self._loop_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.last_report: Optional[SyncReport] = None
+
+    # -- one-shot ------------------------------------------------------------
+    def sync_once(self, host: str, port: int) -> SyncReport:
+        t0 = time.perf_counter()
+        report = SyncReport(peer=f"{host}:{port}")
+
+        remote = self._fetch_remote(host, port)
+        local = {k: v for k, v in self._engine.snapshot()}
+        report.remote_keys = len(remote)
+        report.local_keys = len(local)
+
+        n_union = len(set(local) | set(remote))
+        use_device = (
+            self._device == "tpu"
+            or (self._device == "auto" and n_union >= _DEVICE_THRESHOLD)
+        )
+        local_hashes = _leaf_map(sorted(local.items()), use_device)
+        remote_hashes = _leaf_map(sorted(remote.items()), use_device)
+
+        if use_device:
+            from merklekv_tpu.merkle.diff import diff_keys_pair
+
+            divergent = diff_keys_pair(local_hashes, remote_hashes)
+        else:
+            keys = set(local_hashes) | set(remote_hashes)
+            divergent = sorted(
+                k for k in keys if local_hashes.get(k) != remote_hashes.get(k)
+            )
+        report.divergent = len(divergent)
+
+        for k in divergent:
+            if k in remote:
+                self._engine.set(k, remote[k])
+                report.set_keys += 1
+            else:
+                self._engine.delete(k)
+                report.deleted_keys += 1
+
+        report.seconds = time.perf_counter() - t0
+        self.last_report = report
+        return report
+
+    def _fetch_remote(self, host: str, port: int) -> dict[bytes, bytes]:
+        """One connection: SCAN for keys, then MGET in batches."""
+        out: dict[bytes, bytes] = {}
+        with MerkleKVClient(host, port, timeout=self._timeout) as c:
+            keys = c.scan()
+            for i in range(0, len(keys), self._mget_batch):
+                batch = keys[i : i + self._mget_batch]
+                for k, v in c.mget(batch).items():
+                    if v is None:
+                        # MGET's wire format can't distinguish a missing key
+                        # from a literal "NOT_FOUND" value; GET can (the
+                        # "VALUE " prefix). The key came from SCAN, so only a
+                        # concurrent delete or that literal value lands here.
+                        v = c.get(k)
+                        if v is None:
+                            continue
+                    out[k.encode("utf-8", "surrogateescape")] = v.encode(
+                        "utf-8", "surrogateescape"
+                    )
+        return out
+
+    # -- periodic loop ---------------------------------------------------------
+    def start_loop(self, peers: list[str], interval_seconds: float) -> None:
+        """Periodic anti-entropy against each "host:port" peer."""
+
+        def run() -> None:
+            while not self._stop.wait(interval_seconds):
+                for peer in peers:
+                    if self._stop.is_set():
+                        return
+                    host, _, port = peer.rpartition(":")
+                    try:
+                        self.sync_once(host, int(port))
+                    except Exception:
+                        # Peer down: anti-entropy retries next round; failure
+                        # detection surfaces through last_report staleness.
+                        continue
+
+        self._stop.clear()
+        self._loop_thread = threading.Thread(
+            target=run, daemon=True, name="mkv-anti-entropy"
+        )
+        self._loop_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+            self._loop_thread = None

@@ -1,0 +1,256 @@
+"""Pub/sub event fabric behind a small Transport interface.
+
+The reference replicates through an external MQTT broker over rumqttc
+(replication.rs:115-143, topics "{prefix}/events"). This environment has no
+egress and no broker, so the fabric is pluggable:
+
+- ``InProcessBus`` — loopback fan-out inside one process (unit tests, and
+  multi-node-in-one-process topologies like the integration suite's).
+- ``TcpBroker`` + ``TcpTransport`` — a minimal self-hosted broker speaking
+  length-framed (topic, payload) messages over TCP, QoS-0 fan-out to every
+  connected client (MQTT-like enough for LWW replication, which tolerates
+  loss by design — anti-entropy repairs). One broker serves a whole
+  single-host cluster; multi-host works the same over DCN.
+
+Delivery is at-most-once per connection; the replication layer's op_id
+dedupe + LWW make redelivery and reordering safe either way.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Optional, Protocol
+
+__all__ = ["Transport", "InProcessBus", "TcpBroker", "TcpTransport"]
+
+Callback = Callable[[str, bytes], None]
+
+
+class Transport(Protocol):
+    def publish(self, topic: str, payload: bytes) -> None: ...
+    def subscribe(self, topic_prefix: str, callback: Callback) -> None: ...
+    def unsubscribe(self, callback: Callback) -> None: ...
+    def close(self) -> None: ...
+
+
+# ------------------------------------------------------------- in-process
+
+class InProcessBus:
+    """Fan-out bus inside one process. Delivery happens on a dispatcher
+    thread, so publishers never run subscriber callbacks inline."""
+
+    def __init__(self) -> None:
+        self._subs: list[tuple[str, Callback]] = []
+        self._mu = threading.Lock()
+        self._q: list[tuple[str, bytes]] = []
+        self._cv = threading.Condition(self._mu)
+        self._closed = False
+        self._thread = threading.Thread(target=self._dispatch, daemon=True)
+        self._thread.start()
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._q.append((topic, payload))
+            self._cv.notify()
+
+    def subscribe(self, topic_prefix: str, callback: Callback) -> None:
+        with self._mu:
+            self._subs.append((topic_prefix, callback))
+
+    def unsubscribe(self, callback: Callback) -> None:
+        with self._mu:
+            self._subs = [(p, c) for p, c in self._subs if c is not callback]
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._thread.join(timeout=2)
+
+    def _dispatch(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._q:
+                    return
+                topic, payload = self._q.pop(0)
+                subs = list(self._subs)
+            for prefix, cb in subs:
+                if topic.startswith(prefix):
+                    try:
+                        cb(topic, payload)
+                    except Exception:
+                        pass  # subscriber errors must not kill the bus
+
+
+# ------------------------------------------------------------- TCP broker
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _read_frame(sock: socket.socket) -> Optional[tuple[str, bytes]]:
+    head = _read_exact(sock, 6)
+    if head is None:
+        return None
+    total, tlen = struct.unpack("<IH", head)
+    body = _read_exact(sock, total)
+    if body is None or tlen > total:
+        return None
+    return body[:tlen].decode("utf-8"), body[tlen:]
+
+
+def _frame(topic: str, payload: bytes) -> bytes:
+    t = topic.encode("utf-8")
+    return struct.pack("<IH", len(t) + len(payload), len(t)) + t + payload
+
+
+class TcpBroker:
+    """Self-hosted fan-out broker: every frame from any client goes to every
+    connected client (including the sender — src-based loop prevention is the
+    subscriber's job, as with MQTT)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host, self.port = self._listener.getsockname()
+        # cid -> (socket, per-socket send lock): concurrent publishers must
+        # not interleave partial sendall()s on one subscriber's stream.
+        self._clients: dict[int, tuple[socket.socket, threading.Lock]] = {}
+        self._next_id = 0
+        self._mu = threading.Lock()
+        self._closed = False
+        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread.start()
+
+    def _accept(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._mu:
+                cid = self._next_id
+                self._next_id += 1
+                self._clients[cid] = (sock, threading.Lock())
+            threading.Thread(
+                target=self._serve, args=(cid, sock), daemon=True
+            ).start()
+
+    def _serve(self, cid: int, sock: socket.socket) -> None:
+        while True:
+            frame = _read_frame(sock)
+            if frame is None:
+                break
+            data = _frame(*frame)
+            with self._mu:
+                targets = list(self._clients.items())
+            for tid, (tsock, tlock) in targets:
+                try:
+                    with tlock:
+                        tsock.sendall(data)
+                except OSError:
+                    self._drop(tid)
+        self._drop(cid)
+
+    def _drop(self, cid: int) -> None:
+        with self._mu:
+            entry = self._clients.pop(cid, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mu:
+            entries = list(self._clients.values())
+            self._clients.clear()
+        for s, _lk in entries:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class TcpTransport:
+    """Client for TcpBroker implementing the Transport interface."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._subs: list[tuple[str, Callback]] = []
+        self._mu = threading.Lock()
+        self._send_mu = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._send_mu:
+            try:
+                self._sock.sendall(_frame(topic, payload))
+            except OSError:
+                pass  # QoS-0: drop on broken broker link
+
+    def subscribe(self, topic_prefix: str, callback: Callback) -> None:
+        with self._mu:
+            self._subs.append((topic_prefix, callback))
+
+    def unsubscribe(self, callback: Callback) -> None:
+        with self._mu:
+            self._subs = [(p, c) for p, c in self._subs if c is not callback]
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            frame = _read_frame(self._sock)
+            if frame is None:
+                return
+            topic, payload = frame
+            with self._mu:
+                subs = list(self._subs)
+            for prefix, cb in subs:
+                if topic.startswith(prefix):
+                    try:
+                        cb(topic, payload)
+                    except Exception:
+                        pass
+
+
+def make_transport(broker: str, port: int) -> Transport:
+    """Config-driven transport selection: "local"/"inproc" -> private
+    InProcessBus; anything else -> TCP broker client."""
+    if broker in ("local", "inproc", ""):
+        return InProcessBus()
+    return TcpTransport(broker, port)

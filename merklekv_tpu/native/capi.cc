@@ -261,6 +261,10 @@ void mkv_server_set_cluster_cb(void* h, mkv_cluster_cb cb, void* ctx) {
   });
 }
 
+void mkv_server_enable_events(void* h, int on) {
+  static_cast<ServerHandle*>(h)->server->set_events_enabled(on != 0);
+}
+
 // Drain up to max_events change events. Serialization per event: u8 op,
 // u8 has_value, u64 ts_ns, u64 seq, u32 klen, key, u32 vlen, value; prefixed
 // with u32 count. Free with mkv_free.

@@ -10,6 +10,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <functional>
 
 #include "merkle.h"
 #include "protocol.h"
@@ -45,42 +46,41 @@ Server::~Server() {
 }
 
 bool Server::start() {
-  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (listen_fd_ < 0) return false;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
   int one = 1;
-  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(opts_.port);
   if (::inet_pton(AF_INET, opts_.host.c_str(), &addr.sin_addr) != 1) {
     addr.sin_addr.s_addr = htonl(INADDR_ANY);
   }
-  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
-      0) {
-    ::close(listen_fd_);
-    listen_fd_ = -1;
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
     return false;
   }
-  if (::listen(listen_fd_, 1024) < 0) {
-    ::close(listen_fd_);
-    listen_fd_ = -1;
+  if (::listen(fd, 1024) < 0) {
+    ::close(fd);
     return false;
   }
   sockaddr_in bound{};
   socklen_t blen = sizeof(bound);
-  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&bound), &blen);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
   bound_port_ = ntohs(bound.sin_port);
+  listen_fd_.store(fd, std::memory_order_release);
   accept_thread_ = std::thread([this] { accept_loop(); });
   return true;
 }
 
 void Server::stop() {
-  bool expected = false;
-  if (!stop_.compare_exchange_strong(expected, true)) {
-    // Already stopping; still make sure sockets are poked below.
-  }
-  if (listen_fd_ >= 0) {
-    ::shutdown(listen_fd_, SHUT_RDWR);
+  stop_.store(true, std::memory_order_release);
+  {
+    // Only shutdown() here — the single close() happens in wait() after the
+    // accept thread has exited, so no thread ever touches a recycled fd.
+    std::lock_guard lk(lifecycle_mu_);
+    int fd = listen_fd_.load(std::memory_order_acquire);
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
   }
   std::lock_guard lk(clients_mu_);
   for (auto& [id, meta] : clients_) {
@@ -91,6 +91,11 @@ void Server::stop() {
 
 void Server::wait() {
   if (accept_thread_.joinable()) accept_thread_.join();
+  {
+    std::lock_guard lk(lifecycle_mu_);
+    int fd = listen_fd_.exchange(-1, std::memory_order_acq_rel);
+    if (fd >= 0) ::close(fd);
+  }
   // Handler threads are detached; spin briefly until they all unregister.
   while (live_handlers_.load(std::memory_order_acquire) > 0) {
     ::usleep(1000);
@@ -103,10 +108,11 @@ void Server::set_cluster_callback(ClusterCallback cb) {
 }
 
 void Server::accept_loop() {
+  const int lfd = listen_fd_.load(std::memory_order_acquire);
   for (;;) {
     sockaddr_in peer{};
     socklen_t plen = sizeof(peer);
-    int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &plen);
+    int fd = ::accept(lfd, reinterpret_cast<sockaddr*>(&peer), &plen);
     if (fd < 0) {
       if (stop_.load(std::memory_order_acquire)) break;
       if (errno == EINTR) continue;
@@ -153,10 +159,6 @@ void Server::accept_loop() {
       }
     }).detach();
   }
-  if (listen_fd_ >= 0) {
-    ::close(listen_fd_);
-    listen_fd_ = -1;
-  }
 }
 
 bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
@@ -194,6 +196,17 @@ bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
   }
 }
 
+std::mutex& Server::write_stripe(const std::string& key) {
+  return write_stripes_[std::hash<std::string>{}(key) % kWriteStripes];
+}
+
+void Server::stage_event(ChangeOp op, const std::string& key,
+                         const std::string& value, bool has_value) {
+  if (events_enabled_.load(std::memory_order_acquire)) {
+    events_.push(op, key, value, has_value);
+  }
+}
+
 std::string Server::dispatch(const Command& cmd, bool* close_conn) {
   switch (cmd.verb) {
     case Verb::Get: {
@@ -220,13 +233,15 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       return out;
     }
     case Verb::Set: {
+      std::lock_guard lk(write_stripe(cmd.key));
       if (!engine_->set(cmd.key, cmd.value)) return "ERROR set failed\r\n";
-      events_.push(ChangeOp::Set, cmd.key, cmd.value, true);
+      stage_event(ChangeOp::Set, cmd.key, cmd.value, true);
       return "OK\r\n";
     }
     case Verb::Delete: {
+      std::lock_guard lk(write_stripe(cmd.key));
       if (engine_->del(cmd.key)) {
-        events_.push(ChangeOp::Del, cmd.key, "", false);
+        stage_event(ChangeOp::Del, cmd.key, "", false);
         return "DELETED\r\n";
       }
       return "NOT_FOUND\r\n";
@@ -302,10 +317,11 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
     case Verb::Increment:
     case Verb::Decrement: {
       int64_t amount = cmd.amount.value_or(1);
+      std::lock_guard lk(write_stripe(cmd.key));
       auto r = cmd.verb == Verb::Increment ? engine_->increment(cmd.key, amount)
                                            : engine_->decrement(cmd.key, amount);
       if (!r.ok) return "ERROR " + r.error + "\r\n";
-      events_.push(
+      stage_event(
           cmd.verb == Verb::Increment ? ChangeOp::Incr : ChangeOp::Decr,
           cmd.key, std::to_string(r.value), true);
       return "VALUE " + std::to_string(r.value) + "\r\n";
@@ -317,10 +333,11 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         auto v = engine_->get(cmd.key);
         return v ? "VALUE " + *v + "\r\n" : "ERROR Key not found\r\n";
       }
+      std::lock_guard lk(write_stripe(cmd.key));
       auto r = cmd.verb == Verb::Append ? engine_->append(cmd.key, cmd.value)
                                         : engine_->prepend(cmd.key, cmd.value);
       if (!r.ok) return "ERROR " + r.error + "\r\n";
-      events_.push(
+      stage_event(
           cmd.verb == Verb::Append ? ChangeOp::Append : ChangeOp::Prepend,
           cmd.key, r.value, true);
       return "VALUE " + r.value + "\r\n";
@@ -341,8 +358,9 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
     }
     case Verb::MultiSet: {
       for (const auto& [k, v] : cmd.pairs) {
+        std::lock_guard lk(write_stripe(k));
         if (!engine_->set(k, v)) return "ERROR set failed\r\n";
-        events_.push(ChangeOp::Set, k, v, true);
+        stage_event(ChangeOp::Set, k, v, true);
       }
       return "OK\r\n";
     }

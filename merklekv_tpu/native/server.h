@@ -68,6 +68,14 @@ class Server {
   void set_cluster_callback(ClusterCallback cb);
   EventQueue& events() { return events_; }
   ServerStats& stats() { return stats_; }
+  // Change-event staging is opt-in: without a drainer (standalone binary,
+  // replication disabled) staging would pin up to capacity keys+values.
+  void set_events_enabled(bool on) {
+    events_enabled_.store(on, std::memory_order_release);
+  }
+  bool events_enabled() const {
+    return events_enabled_.load(std::memory_order_acquire);
+  }
 
  private:
   void accept_loop();
@@ -75,11 +83,21 @@ class Server {
   bool handle_connection(int fd, std::shared_ptr<ClientMeta> meta);
   std::string dispatch(const Command& cmd, bool* close_conn);
 
+  // Serializes (engine write + event push) per key stripe so the staged
+  // event order always matches the engine's final state for a key.
+  std::mutex& write_stripe(const std::string& key);
+  void stage_event(ChangeOp op, const std::string& key,
+                   const std::string& value, bool has_value);
+
   Engine* engine_;
   ServerOptions opts_;
   ServerStats stats_;
   EventQueue events_;
-  int listen_fd_ = -1;
+  std::atomic<bool> events_enabled_{false};
+  static constexpr size_t kWriteStripes = 64;
+  std::mutex write_stripes_[kWriteStripes];
+  std::atomic<int> listen_fd_{-1};
+  std::mutex lifecycle_mu_;
   uint16_t bound_port_ = 0;
   std::thread accept_thread_;
   std::atomic<bool> stop_{false};

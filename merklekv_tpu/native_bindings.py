@@ -126,6 +126,7 @@ def _load() -> ctypes.CDLL:
     lib.mkv_server_set_cluster_cb.argtypes = [
         ctypes.c_void_p, _CLUSTER_CB, ctypes.c_void_p,
     ]
+    lib.mkv_server_enable_events.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mkv_server_drain_events.argtypes = [
         ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
     ]
@@ -372,6 +373,11 @@ class NativeServer:
 
         self._cb_ref = _CLUSTER_CB(trampoline)  # keep trampoline alive
         self._lib.mkv_server_set_cluster_cb(self._h, self._cb_ref, None)
+
+    def enable_events(self, on: bool = True) -> None:
+        """Turn change-event staging on/off. Off by default — without a
+        drainer the queue would pin keys+values for up to 2^20 writes."""
+        self._lib.mkv_server_enable_events(self._h, 1 if on else 0)
 
     def drain_events(self, max_events: int = 0) -> list[ChangeEventRaw]:
         out = ctypes.c_void_p()

@@ -1,0 +1,159 @@
+"""ChangeEvent codecs + pure LWW applier (no transport).
+
+Mirrors the reference's codec roundtrip tests and its LocalApplier fake
+(change_event.rs:194-460): idempotency, LWW, deterministic ts tie-break —
+tested as pure functions against a plain dict store.
+"""
+
+import pytest
+
+from merklekv_tpu.cluster import (
+    ChangeEvent,
+    LWWApplier,
+    OpKind,
+    decode_any,
+    decode_binary,
+    decode_cbor,
+    decode_json,
+    encode_binary,
+    encode_cbor,
+    encode_json,
+)
+
+
+def ev(**kw) -> ChangeEvent:
+    base = dict(op=OpKind.SET, key="k", val=b"v", ts=100, src="n1")
+    base.update(kw)
+    return ChangeEvent(**base)
+
+
+# ------------------------------------------------------------------ codecs
+
+@pytest.mark.parametrize(
+    "enc,dec",
+    [(encode_cbor, decode_cbor), (encode_binary, decode_binary),
+     (encode_json, decode_json)],
+)
+def test_roundtrip_all_codecs(enc, dec):
+    for e in [
+        ev(),
+        ev(op=OpKind.DEL, val=None),
+        ev(op=OpKind.INCR, val=b"42"),
+        ev(val=b"\x00\xff binary \t bytes"),
+        ev(prev=b"\xab" * 32, ttl=3600),
+        ev(key="unicode-ключ-☃", src="node-β"),
+        ev(ts=2**63 + 5),  # > i64: u64 range must survive
+    ]:
+        assert dec(enc(e)) == e
+
+
+def test_decode_any_tries_all():
+    e = ev()
+    assert decode_any(encode_cbor(e)) == e
+    assert decode_any(encode_binary(e)) == e
+    assert decode_any(encode_json(e)) == e
+    with pytest.raises(ValueError):
+        decode_any(b"\x00garbage not an event")
+    with pytest.raises(ValueError):
+        decode_any(b"")
+
+
+def test_cbor_is_standard_subset():
+    # A well-formed RFC 8949 map readable by any CBOR decoder: major 5 head.
+    data = encode_cbor(ev())
+    assert data[0] >> 5 == 5
+    assert data[0] & 0x1F == 9  # nine fields
+
+
+def test_op_id_validation():
+    with pytest.raises(ValueError):
+        ChangeEvent(op=OpKind.SET, key="k", val=b"v", ts=1, src="s", op_id=b"short")
+    with pytest.raises(ValueError):
+        ChangeEvent(op=OpKind.SET, key="k", val=b"v", ts=1, src="s",
+                    prev=b"tooshort")
+
+
+# ------------------------------------------------------------------ applier
+
+@pytest.fixture
+def store_and_applier():
+    store: dict[bytes, bytes] = {}
+    applier = LWWApplier(
+        lambda k, v: store.__setitem__(k, v),
+        lambda k: store.pop(k, None),
+    )
+    return store, applier
+
+
+def test_apply_set_and_del(store_and_applier):
+    store, a = store_and_applier
+    assert a.apply(ev(ts=1))
+    assert store == {b"k": b"v"}
+    assert a.apply(ev(op=OpKind.DEL, val=None, ts=2))
+    assert store == {}
+
+
+def test_idempotency(store_and_applier):
+    store, a = store_and_applier
+    e = ev(ts=5)
+    assert a.apply(e)
+    assert not a.apply(e)  # duplicate op_id dropped
+    assert a.skipped_dup == 1
+    assert a.applied == 1
+
+
+def test_lww_rejects_older(store_and_applier):
+    store, a = store_and_applier
+    a.apply(ev(ts=100, val=b"new"))
+    assert not a.apply(ev(ts=50, val=b"old"))
+    assert store[b"k"] == b"new"
+    assert a.skipped_lww == 1
+
+
+def test_lww_accepts_newer_and_equal_ordering(store_and_applier):
+    store, a = store_and_applier
+    a.apply(ev(ts=100, val=b"first"))
+    assert a.apply(ev(ts=200, val=b"second"))
+    assert store[b"k"] == b"second"
+
+
+def test_tie_break_is_deterministic(store_and_applier):
+    # Equal ts: larger op_id wins, regardless of arrival order
+    # (change_event.rs:222-246 rule).
+    store, a = store_and_applier
+    lo = ev(ts=100, val=b"lo", op_id=b"\x01" * 16)
+    hi = ev(ts=100, val=b"hi", op_id=b"\xfe" * 16)
+    a.apply(lo)
+    assert a.apply(hi)
+    assert store[b"k"] == b"hi"
+
+    store2: dict[bytes, bytes] = {}
+    a2 = LWWApplier(lambda k, v: store2.__setitem__(k, v),
+                    lambda k: store2.pop(k, None))
+    a2.apply(hi)
+    assert not a2.apply(lo)  # smaller op_id at equal ts is rejected
+    assert store2[b"k"] == b"hi"
+
+
+def test_post_op_semantics_incr_applies_as_set(store_and_applier):
+    store, a = store_and_applier
+    a.apply(ev(op=OpKind.INCR, val=b"7", ts=1))
+    assert store[b"k"] == b"7"  # post-op result, not a re-executed increment
+    a.apply(ev(op=OpKind.APPEND, val=b"7x", ts=2))
+    assert store[b"k"] == b"7x"
+
+
+def test_seen_set_is_bounded():
+    store: dict[bytes, bytes] = {}
+    a = LWWApplier(lambda k, v: store.__setitem__(k, v),
+                   lambda k: store.pop(k, None), max_seen=10)
+    for i in range(25):
+        a.apply(ev(key=f"k{i}", ts=i + 1, op_id=i.to_bytes(16, "big")))
+    assert len(a._seen) <= 11
+
+
+def test_per_key_independence(store_and_applier):
+    store, a = store_and_applier
+    a.apply(ev(key="a", ts=100, val=b"1"))
+    assert a.apply(ev(key="b", ts=50, val=b"2"))  # other key, older ts fine
+    assert store == {b"a": b"1", b"b": b"2"}

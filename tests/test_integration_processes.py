@@ -1,0 +1,168 @@
+"""Full-process integration: spawned servers + broker, like the reference's
+tests/integration harness (conftest.py spawns the cargo binary and polls the
+port; test_replication.py points multiple server processes at a broker).
+
+Here: real `python -m merklekv_tpu` processes, a real
+`python -m merklekv_tpu.broker` process, TOML config files, TCP clients.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient
+
+pytestmark = pytest.mark.integration
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(args, **kw):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    # Server processes don't need a TPU; keep jax out of their startup path.
+    return subprocess.Popen(
+        [sys.executable, *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        **kw,
+    )
+
+
+def _port_from(proc) -> int:
+    line = proc.stdout.readline()
+    assert "listening on" in line, f"unexpected startup line: {line!r}"
+    return int(line.rsplit(":", 1)[1].split()[0])
+
+
+def _wait_port(port, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"port {port} never came up")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Broker + two replicating server processes (TOML-configured)."""
+    procs = []
+    broker = _spawn(["-m", "merklekv_tpu.broker", "--port", "0"])
+    procs.append(broker)
+    broker_port = _port_from(broker)
+
+    ports = []
+    for i in (1, 2):
+        cfg = tmp_path / f"node{i}.toml"
+        cfg.write_text(
+            f"""
+host = "127.0.0.1"
+port = 0
+engine = "mem"
+
+[replication]
+enabled = true
+mqtt_broker = "127.0.0.1"
+mqtt_port = {broker_port}
+topic_prefix = "itest"
+client_id = "node-{i}"
+"""
+        )
+        p = _spawn(["-m", "merklekv_tpu", "--config", str(cfg)])
+        procs.append(p)
+        port = _port_from(p)
+        _wait_port(port)
+        ports.append(port)
+
+    yield ports
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+        out = p.stdout.read()
+        if out.strip():
+            print(f"--- proc output ---\n{out}")
+
+
+def test_cross_process_replication(cluster):
+    p1, p2 = cluster
+    with MerkleKVClient("127.0.0.1", p1) as c1, MerkleKVClient(
+        "127.0.0.1", p2
+    ) as c2:
+        c1.set("xp", "hello")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if c2.get("xp") == "hello":
+                break
+            time.sleep(0.05)
+        assert c2.get("xp") == "hello"
+
+        c2.increment("shared-ctr", 3)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if c1.get("shared-ctr") == "3":
+                break
+            time.sleep(0.05)
+        assert c1.get("shared-ctr") == "3"
+
+        # Roots converge across processes.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if c1.hash() == c2.hash():
+                break
+            time.sleep(0.05)
+        assert c1.hash() == c2.hash()
+
+
+def test_cross_process_sync_command(cluster):
+    p1, p2 = cluster
+    with MerkleKVClient("127.0.0.1", p1) as c1, MerkleKVClient(
+        "127.0.0.1", p2
+    ) as c2:
+        # Disable replication on both so only SYNC moves data.
+        c1.replicate("disable")
+        c2.replicate("disable")
+        c1.set("only1", "v1")
+        assert c2.get("only1") is None
+        assert c2.sync_with("127.0.0.1", p1)
+        assert c2.get("only1") == "v1"
+        assert c1.hash() == c2.hash()
+
+
+def test_persistence_across_restart(tmp_path):
+    data = tmp_path / "data"
+    p = _spawn(
+        ["-m", "merklekv_tpu", "--port", "0", "--engine", "log",
+         "--storage-path", str(data)]
+    )
+    port = _port_from(p)
+    _wait_port(port)
+    with MerkleKVClient("127.0.0.1", port) as c:
+        c.set("durable", "state")
+        c.shutdown()
+    p.wait(timeout=10)
+
+    p2 = _spawn(
+        ["-m", "merklekv_tpu", "--port", "0", "--engine", "log",
+         "--storage-path", str(data)]
+    )
+    port2 = _port_from(p2)
+    _wait_port(port2)
+    try:
+        with MerkleKVClient("127.0.0.1", port2) as c:
+            assert c.get("durable") == "state"
+    finally:
+        p2.terminate()
+        p2.wait(timeout=5)

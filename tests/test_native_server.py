@@ -236,6 +236,10 @@ def test_pipelined_commands_one_packet(server):
 # ------------------------------------------------------------ events
 
 def test_change_events_drained(server, client):
+    # Staging is opt-in: writes before enable_events are not staged.
+    client.set("pre-enable", "x")
+    server.enable_events(True)
+    assert server.drain_events() == []
     client.set("k1", "v1")
     client.increment("n", 2)
     client.delete("k1")

@@ -1,0 +1,192 @@
+"""Multi-node replication on one host (reference test_replication.py model).
+
+Spins up N embedded native servers in this process, all joined through a
+self-hosted TcpBroker (the reference points multiple server processes at a
+real MQTT broker; same topology, no egress). Convergence is asserted by
+polling GETs with a latency budget — but ours is milliseconds, not the
+reference's 3-5 s public-broker budget.
+"""
+
+import time
+import uuid
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind, encode_cbor
+from merklekv_tpu.cluster.node import ClusterNode
+from merklekv_tpu.cluster.transport import TcpBroker, TcpTransport
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+
+class Node:
+    """One embedded server + cluster control plane."""
+
+    def __init__(self, broker: TcpBroker, topic: str, node_id: str):
+        self.engine = NativeEngine("mem")
+        self.server = NativeServer(self.engine, "127.0.0.1", 0)
+        self.server.start()
+        cfg = Config()
+        cfg.replication.enabled = True
+        cfg.replication.mqtt_broker = broker.host
+        cfg.replication.mqtt_port = broker.port
+        cfg.replication.topic_prefix = topic
+        cfg.replication.client_id = node_id
+        cfg.replication.peer_list = ["a", "b"]
+        self.cluster = ClusterNode(cfg, self.engine, self.server)
+        self.cluster.start()
+        self.client = MerkleKVClient("127.0.0.1", self.server.port).connect()
+
+    def close(self):
+        self.client.close()
+        self.cluster.stop()
+        self.server.close()
+        self.engine.close()
+
+
+@pytest.fixture
+def broker():
+    b = TcpBroker()
+    yield b
+    b.close()
+
+
+@pytest.fixture
+def pair(broker):
+    topic = f"test-{uuid.uuid4().hex[:8]}"  # uniquified per test run
+    n1 = Node(broker, topic, "node-1")
+    n2 = Node(broker, topic, "node-2")
+    yield n1, n2
+    n1.close()
+    n2.close()
+
+
+def wait_for(fn, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_set_propagates(pair):
+    n1, n2 = pair
+    n1.client.set("rk", "rv")
+    assert wait_for(lambda: n2.client.get("rk") == "rv")
+
+
+def test_delete_propagates(pair):
+    n1, n2 = pair
+    n1.client.set("dk", "dv")
+    assert wait_for(lambda: n2.client.get("dk") == "dv")
+    n1.client.delete("dk")
+    assert wait_for(lambda: n2.client.get("dk") is None)
+
+
+def test_numeric_and_string_ops_replicate_post_op(pair):
+    n1, n2 = pair
+    n1.client.increment("num", 5)
+    n1.client.increment("num", 2)
+    assert wait_for(lambda: n2.client.get("num") == "7")
+    n1.client.append("s", "ab")
+    n1.client.prepend("s", "x")
+    assert wait_for(lambda: n2.client.get("s") == "xab")
+
+
+def test_bidirectional(pair):
+    n1, n2 = pair
+    n1.client.set("from1", "a")
+    n2.client.set("from2", "b")
+    assert wait_for(lambda: n2.client.get("from1") == "a")
+    assert wait_for(lambda: n1.client.get("from2") == "b")
+
+
+def test_no_echo_loop(pair):
+    n1, n2 = pair
+    n1.client.set("loop", "v")
+    assert wait_for(lambda: n2.client.get("loop") == "v")
+    time.sleep(0.2)  # would re-publish within this window if looping
+    assert n1.cluster.replicator.received <= 1
+    # Applied remote writes must not re-enter node-2's publish queue.
+    assert n2.cluster.replicator.published == 0
+
+
+def test_concurrent_writers_converge(pair):
+    n1, n2 = pair
+    for i in range(50):
+        (n1 if i % 2 else n2).client.set(f"cw{i}", f"v{i}")
+
+    def converged():
+        for i in range(50):
+            if n1.client.get(f"cw{i}") != f"v{i}":
+                return False
+            if n2.client.get(f"cw{i}") != f"v{i}":
+                return False
+        return True
+
+    assert wait_for(converged)
+    # Merkle roots agree after convergence.
+    assert n1.client.hash() == n2.client.hash()
+
+
+def test_malformed_messages_tolerated(pair, broker):
+    n1, n2 = pair
+    topic = n1.cluster._cfg.replication.topic_prefix + "/events"
+    rogue = TcpTransport(broker.host, broker.port)
+    rogue.publish(topic, b"\xff\xfenot an event")
+    rogue.publish(topic, b"")
+    n1.client.set("after-garbage", "ok")
+    assert wait_for(lambda: n2.client.get("after-garbage") == "ok")
+    assert n2.cluster.replicator.decode_errors >= 1
+    rogue.close()
+
+
+def test_stale_event_rejected_by_lww(pair):
+    n1, n2 = pair
+    n1.client.set("lww", "current")
+    assert wait_for(lambda: n2.client.get("lww") == "current")
+    # Inject an old event directly (simulates a delayed redelivery).
+    stale = ChangeEvent(op=OpKind.SET, key="lww", val=b"ancient", ts=1,
+                        src="node-3")
+    n2.cluster.replicator._on_message("t", encode_cbor(stale))
+    assert n2.client.get("lww") == "current"
+
+
+def test_replicate_status_commands(pair):
+    n1, _ = pair
+    assert n1.client.replicate("status") == "REPLICATION enabled 2 nodes"
+    assert n1.client.replicate("disable") == "OK"
+    assert n1.client.replicate("status") == "REPLICATION disabled"
+    assert n1.client.replicate("enable") == "OK"
+    assert n1.client.replicate("status") == "REPLICATION enabled 2 nodes"
+
+
+def test_node_restart_catches_up_via_sync(broker):
+    """Reference scenario test_replication.py:556 — a restarted node misses
+    events; anti-entropy repairs it."""
+    topic = f"test-{uuid.uuid4().hex[:8]}"
+    n1 = Node(broker, topic, "node-1")
+    n2 = Node(broker, topic, "node-2")
+    try:
+        n1.client.set("pre", "1")
+        assert wait_for(lambda: n2.client.get("pre") == "1")
+        # "Restart" node 2: drop its state while offline.
+        n2.cluster.stop()
+        n2.engine.truncate()
+        n1.client.set("while-down", "2")
+        time.sleep(0.1)
+        # Node 2 back up with a fresh control plane.
+        n2.cluster = ClusterNode(n2.cluster._cfg, n2.engine, n2.server)
+        n2.cluster.start()
+        # Replication alone can't recover the missed event...
+        assert n2.client.get("while-down") is None
+        # ...anti-entropy does.
+        n2.client.sync_with("127.0.0.1", n1.server.port)
+        assert n2.client.get("while-down") == "2"
+        assert n2.client.get("pre") == "1"
+        assert n1.client.hash() == n2.client.hash()
+    finally:
+        n1.close()
+        n2.close()

@@ -1,0 +1,125 @@
+"""Anti-entropy sync manager: one-way convergence, batching, periodic loop.
+
+Reference semantics (sync.rs:56-87): after sync_once the local store equals
+the remote peer — overwrites, additions, AND deletion of local-only keys.
+"""
+
+import time
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.cluster.sync import SyncManager
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+
+@pytest.fixture
+def two_nodes():
+    nodes = []
+    for _ in range(2):
+        eng = NativeEngine("mem")
+        srv = NativeServer(eng, "127.0.0.1", 0)
+        srv.start()
+        nodes.append((eng, srv))
+    yield nodes
+    for eng, srv in nodes:
+        srv.close()
+        eng.close()
+
+
+def fill(eng, items):
+    for k, v in items.items():
+        eng.set(k.encode(), v.encode())
+
+
+def test_sync_once_converges(two_nodes):
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    fill(remote_eng, {"shared": "remote-version", "remote-only": "r"})
+    fill(local_eng, {"shared": "local-version", "local-only": "l"})
+
+    mgr = SyncManager(local_eng, device="cpu")
+    report = mgr.sync_once("127.0.0.1", remote_srv.port)
+
+    assert local_eng.snapshot() == remote_eng.snapshot()
+    assert report.divergent == 3
+    assert report.set_keys == 2  # shared overwritten + remote-only added
+    assert report.deleted_keys == 1  # local-only removed
+    assert local_eng.merkle_root() == remote_eng.merkle_root()
+
+
+def test_sync_identical_is_noop(two_nodes):
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    items = {f"same{i}": f"v{i}" for i in range(40)}
+    fill(local_eng, items)
+    fill(remote_eng, items)
+    report = SyncManager(local_eng, device="cpu").sync_once(
+        "127.0.0.1", remote_srv.port
+    )
+    assert report.divergent == 0
+    assert report.set_keys == report.deleted_keys == 0
+
+
+def test_sync_empty_remote_clears_local(two_nodes):
+    (local_eng, _), (_, remote_srv) = two_nodes
+    fill(local_eng, {"a": "1", "b": "2"})
+    SyncManager(local_eng, device="cpu").sync_once("127.0.0.1", remote_srv.port)
+    assert local_eng.dbsize() == 0
+
+
+def test_sync_large_keyspace_batched_mget(two_nodes):
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    items = {f"bulk{i:05d}": f"value-{i}" for i in range(1500)}
+    fill(remote_eng, items)
+    mgr = SyncManager(local_eng, device="cpu", mget_batch=128)
+    report = mgr.sync_once("127.0.0.1", remote_srv.port)
+    assert report.set_keys == 1500
+    assert local_eng.merkle_root() == remote_eng.merkle_root()
+
+
+def test_sync_device_path_matches_cpu(two_nodes):
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    fill(remote_eng, {f"dk{i}": f"dv{i}" for i in range(64)})
+    fill(local_eng, {"dk1": "stale", "extra": "x"})
+    report = SyncManager(local_eng, device="tpu").sync_once(
+        "127.0.0.1", remote_srv.port
+    )
+    assert local_eng.snapshot() == remote_eng.snapshot()
+    assert report.deleted_keys == 1
+
+
+def test_sync_command_over_protocol(two_nodes):
+    """SYNC via the text protocol, wired through the cluster callback."""
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.config import Config
+
+    (local_eng, local_srv), (remote_eng, remote_srv) = two_nodes
+    fill(remote_eng, {"proto": "synced"})
+    node = ClusterNode(Config(), local_eng, local_srv)
+    node.start()
+    try:
+        with MerkleKVClient("127.0.0.1", local_srv.port) as c:
+            assert c.sync_with("127.0.0.1", remote_srv.port)
+            assert c.get("proto") == "synced"
+            # Unreachable peer -> ERROR (flags parsed; reference drops them)
+            import merklekv_tpu.client as mc
+
+            with pytest.raises(mc.ProtocolError):
+                c.sync_with("127.0.0.1", 1)
+    finally:
+        node.stop()
+
+
+def test_periodic_loop_repairs(two_nodes):
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    fill(remote_eng, {"auto": "repaired"})
+    mgr = SyncManager(local_eng, device="cpu")
+    mgr.start_loop([f"127.0.0.1:{remote_srv.port}"], interval_seconds=0.05)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if local_eng.get(b"auto") == b"repaired":
+                break
+            time.sleep(0.02)
+        assert local_eng.get(b"auto") == b"repaired"
+    finally:
+        mgr.stop()
