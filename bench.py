@@ -64,15 +64,27 @@ def bench_tpu(n: int) -> float:
 
     from merklekv_tpu.merkle.jax_engine import anti_entropy_forward
     from merklekv_tpu.merkle.packing import pack_leaves
+    from merklekv_tpu.ops.sha256_pallas import pallas_supported
 
     keys, values = _make_kv(n)
     packed = pack_leaves(keys, values)
 
+    import jax.numpy as jnp
+
+    from merklekv_tpu.merkle.jax_engine import anti_entropy_forward_pallas
+
+    # TPU: Pallas kernels (rounds in VMEM); otherwise the portable scan path.
+    forward = (
+        anti_entropy_forward_pallas if pallas_supported() else anti_entropy_forward
+    )
+
     @jax.jit
-    def step(blocks, nblocks, stacked, present):
-        root, _masks, counts = anti_entropy_forward(
-            blocks, nblocks, stacked, present
-        )
+    def step(blocks, nblocks, stacked, present, salt):
+        # salt (previous root) perturbs one message word: every chained call
+        # computes fresh data, defeating any executable/result caching
+        # between identically-argued runs.
+        blocks = blocks.at[0, 0, :8].set(blocks[0, 0, :8] ^ salt)
+        root, _masks, counts = forward(blocks, nblocks, stacked, present)
         return root, counts
 
     rng = np.random.RandomState(7)
@@ -88,29 +100,38 @@ def bench_tpu(n: int) -> float:
     present_d = jax.device_put(present)
 
     # Warmup (compile) + correctness cross-check against the CPU golden core.
-    root, counts = step(blocks_d, nblocks_d, stacked_d, present_d)
-    jax.block_until_ready((root, counts))
+    zero_salt = jnp.zeros(8, jnp.uint32)
+    root, counts = step(blocks_d, nblocks_d, stacked_d, present_d, zero_salt)
+    root_np = np.asarray(root)  # host fetch forces real completion
     from merklekv_tpu.merkle.cpu import build_levels
     from merklekv_tpu.merkle.encoding import leaf_hash
     from merklekv_tpu.ops.sha256 import digest_to_bytes
 
-    n_chk = 1 << 10
+    # Large enough that tree_root_pallas uses the Pallas node kernel
+    # (pairs >= _MIN_PALLAS_PAIRS), so the check covers the timed program.
+    n_chk = 1 << 13
     chk = build_levels([leaf_hash(k, v) for k, v in zip(keys[:n_chk], values[:n_chk])])
     chk_root = step(
-        packed.blocks[:n_chk], packed.nblocks[:n_chk], stacked[:, :n_chk], present[:, :n_chk]
+        packed.blocks[:n_chk], packed.nblocks[:n_chk], stacked[:, :n_chk],
+        present[:, :n_chk], zero_salt,
     )[0]
     if digest_to_bytes(np.asarray(chk_root)) != chk[-1][0]:
         raise AssertionError("device root != CPU golden root")
     if np.asarray(counts).any():
         raise AssertionError("identical replicas must diff to zero")
 
-    # Median of per-execution wall times, each synchronized.
-    times = []
+    # Timing: chain each rep's input on the previous root so no two
+    # executions are identical (defeats any backend result caching), and end
+    # with a host fetch so async dispatch can't hide execution time.
+    # block_until_ready alone does not reliably synchronize through the
+    # tunneled TPU backend.
+    salt = jnp.asarray(root_np)
+    t0 = time.perf_counter()
     for _ in range(REPS):
-        t0 = time.perf_counter()
-        jax.block_until_ready(step(blocks_d, nblocks_d, stacked_d, present_d))
-        times.append(time.perf_counter() - t0)
-    return n / float(np.median(times))
+        salt, counts = step(blocks_d, nblocks_d, stacked_d, present_d, salt)
+    np.asarray(salt)
+    dt = (time.perf_counter() - t0) / REPS
+    return n / dt
 
 
 def main() -> None:

@@ -66,6 +66,22 @@ def anti_entropy_forward(blocks, nblocks, digests, present):
     return root, masks, counts
 
 
+def anti_entropy_forward_pallas(blocks, nblocks, digests, present):
+    """Same program as :func:`anti_entropy_forward` with the SHA-256 work in
+    Pallas kernels (rounds in VMEM). TPU-only; bit-identical outputs."""
+    from merklekv_tpu.merkle.diff import divergence_masks
+    from merklekv_tpu.ops.sha256_pallas import (
+        leaf_digests_pallas,
+        tree_root_pallas,
+    )
+
+    leaves = leaf_digests_pallas(blocks, nblocks)
+    root = tree_root_pallas(leaves)
+    masks = divergence_masks(digests, present)
+    counts = jnp.sum(masks, axis=1, dtype=jnp.int32)
+    return root, masks, counts
+
+
 # ------------------------------------------------------------ leaf hashing
 
 @jax.jit

@@ -18,7 +18,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# MERKLEKV_TEST_BACKEND=tpu runs the suite against the real chip instead of
+# the virtual CPU mesh — this enables the compiled-Pallas kernel tests
+# (gated on backend == "tpu") that are skipped on the CPU mesh.
+if os.environ.get("MERKLEKV_TEST_BACKEND", "cpu") != "tpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
