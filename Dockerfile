@@ -1,0 +1,20 @@
+# merklekv_tpu server image: native C++ runtime + Python control plane.
+# Build:  docker build -t merklekv-tpu .
+# Run:    docker run -p 7379:7379 merklekv-tpu
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /app
+COPY merklekv_tpu/ merklekv_tpu/
+RUN make -C merklekv_tpu/native -j
+
+FROM python:3.12-slim
+WORKDIR /app
+COPY --from=build /app/merklekv_tpu/ merklekv_tpu/
+COPY configs/config.toml ./config.toml
+ENV PYTHONPATH=/app
+EXPOSE 7379
+# The control plane (replication / anti-entropy / TPU data plane) activates
+# from the config; the bare server needs only the stdlib.
+ENTRYPOINT ["python", "-m", "merklekv_tpu"]
+CMD ["--config", "config.toml", "--host", "0.0.0.0"]

@@ -1,0 +1,215 @@
+// merklekv_tpu C++ client — header-only, RAII.
+//
+// Speaks the CRLF text protocol (docs/PROTOCOL.md); same surface class the
+// reference ships in clients/cpp (connect/get/set/del + extended ops),
+// written fresh for this framework. TCP_NODELAY on, default port 7379.
+//
+//   mkvclient::Client c("127.0.0.1", 7379);
+//   c.set("k", "v");
+//   auto v = c.get("k");            // std::optional<std::string>
+//   c.del("k");
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mkvclient {
+
+class Error : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class ProtocolError : public Error {
+ public:
+  using Error::Error;
+};
+
+class Client {
+ public:
+  Client(const std::string& host, uint16_t port = 7379, int timeout_ms = 5000)
+      : host_(host), port_(port), timeout_ms_(timeout_ms) {
+    connect_();
+  }
+
+  ~Client() { close(); }
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  void close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  bool connected() const { return fd_ >= 0; }
+
+  // ---- basic ----
+  std::optional<std::string> get(const std::string& key) {
+    std::string r = request("GET " + key);
+    if (r == "NOT_FOUND") return std::nullopt;
+    return expect_prefix(r, "VALUE ");
+  }
+
+  void set(const std::string& key, const std::string& value) {
+    expect(request("SET " + key + " " + value), "OK");
+  }
+
+  bool del(const std::string& key) {
+    std::string r = request("DELETE " + key);
+    if (r == "DELETED") return true;
+    if (r == "NOT_FOUND") return false;
+    throw ProtocolError("unexpected: " + r);
+  }
+
+  // ---- numeric / string ----
+  long long increment(const std::string& key, long long amount = 1) {
+    return std::stoll(expect_prefix(
+        request("INC " + key + " " + std::to_string(amount)), "VALUE "));
+  }
+  long long decrement(const std::string& key, long long amount = 1) {
+    return std::stoll(expect_prefix(
+        request("DEC " + key + " " + std::to_string(amount)), "VALUE "));
+  }
+  std::string append(const std::string& key, const std::string& v) {
+    return expect_prefix(request("APPEND " + key + " " + v), "VALUE ");
+  }
+  std::string prepend(const std::string& key, const std::string& v) {
+    return expect_prefix(request("PREPEND " + key + " " + v), "VALUE ");
+  }
+
+  // ---- query ----
+  std::vector<std::string> scan(const std::string& prefix = "") {
+    std::string r =
+        request(prefix.empty() ? std::string("SCAN") : "SCAN " + prefix);
+    size_t n = std::stoull(expect_prefix(r, "KEYS "));
+    std::vector<std::string> keys;
+    keys.reserve(n);
+    for (size_t i = 0; i < n; ++i) keys.push_back(read_line());
+    return keys;
+  }
+
+  size_t dbsize() {
+    return std::stoull(expect_prefix(request("DBSIZE"), "DBSIZE "));
+  }
+
+  // Hex Merkle root of the keyspace (empty = 64 zeros).
+  std::string hash() {
+    std::string r = expect_prefix(request("HASH"), "HASH ");
+    return r;
+  }
+
+  bool ping() {
+    return request("PING").rfind("PONG", 0) == 0;
+  }
+
+  std::string echo(const std::string& msg) {
+    return expect_prefix(request("ECHO " + msg), "ECHO ");
+  }
+
+  void flushdb() { expect(request("FLUSHDB"), "OK"); }
+
+  // ---- cluster ----
+  void sync_with(const std::string& host, uint16_t port) {
+    expect(request("SYNC " + host + " " + std::to_string(port)), "OK");
+  }
+
+  // ---- pipeline: send all lines, collect one response line each ----
+  std::vector<std::string> pipeline(const std::vector<std::string>& cmds) {
+    std::string payload;
+    for (const auto& c : cmds) payload += c + "\r\n";
+    send_all(payload);
+    std::vector<std::string> out;
+    out.reserve(cmds.size());
+    for (size_t i = 0; i < cmds.size(); ++i) out.push_back(read_line());
+    return out;
+  }
+
+  // One request line -> first response line (ERROR raised).
+  std::string request(const std::string& line) {
+    send_all(line + "\r\n");
+    std::string r = read_line();
+    if (r.rfind("ERROR ", 0) == 0) throw ProtocolError(r.substr(6));
+    return r;
+  }
+
+ private:
+  void connect_() {
+    struct addrinfo hints {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (::getaddrinfo(host_.c_str(), std::to_string(port_).c_str(), &hints,
+                      &res) != 0 ||
+        res == nullptr) {
+      throw Error("resolve failed: " + host_);
+    }
+    fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) < 0) {
+      ::freeaddrinfo(res);
+      close();
+      throw Error("connect failed: " + host_ + ":" + std::to_string(port_));
+    }
+    ::freeaddrinfo(res);
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    struct timeval tv {};
+    tv.tv_sec = timeout_ms_ / 1000;
+    tv.tv_usec = (timeout_ms_ % 1000) * 1000;
+    ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
+  void send_all(const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t r = ::send(fd_, data.data() + off, data.size() - off, 0);
+      if (r <= 0) throw Error("send failed");
+      off += size_t(r);
+    }
+  }
+
+  std::string read_line() {
+    for (;;) {
+      size_t nl = buf_.find('\n');
+      if (nl != std::string::npos) {
+        std::string line = buf_.substr(0, nl);
+        buf_.erase(0, nl + 1);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        return line;
+      }
+      char chunk[65536];
+      ssize_t r = ::recv(fd_, chunk, sizeof(chunk), 0);
+      if (r <= 0) throw Error("connection closed or timed out");
+      buf_.append(chunk, size_t(r));
+    }
+  }
+
+  static void expect(const std::string& got, const std::string& want) {
+    if (got != want) throw ProtocolError("unexpected: " + got);
+  }
+
+  static std::string expect_prefix(const std::string& got,
+                                   const std::string& prefix) {
+    if (got.rfind(prefix, 0) != 0) throw ProtocolError("unexpected: " + got);
+    return got.substr(prefix.size());
+  }
+
+  std::string host_;
+  uint16_t port_;
+  int timeout_ms_;
+  int fd_ = -1;
+  std::string buf_;
+};
+
+}  // namespace mkvclient

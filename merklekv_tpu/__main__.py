@@ -44,12 +44,14 @@ def main(argv: list[str] | None = None) -> int:
     )
     server.start()
 
-    node = None
-    if cfg.replication.enabled or cfg.anti_entropy.enabled:
-        from merklekv_tpu.cluster.node import ClusterNode
+    # Always wire the cluster control plane: the SYNC command must work on a
+    # bare node (reference parity — SyncManager is unconditional,
+    # server.rs:388-390); replication/anti-entropy loops only start when
+    # enabled in config.
+    from merklekv_tpu.cluster.node import ClusterNode
 
-        node = ClusterNode(cfg, engine, server)
-        node.start()
+    node = ClusterNode(cfg, engine, server)
+    node.start()
 
     # Readiness line LAST: spawning harnesses treat it as "fully up",
     # including the replication subscription (QoS-0 — a publish before the

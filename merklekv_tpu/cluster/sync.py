@@ -28,6 +28,7 @@ from typing import Optional
 from merklekv_tpu.client import MerkleKVClient
 from merklekv_tpu.merkle.encoding import leaf_hash
 from merklekv_tpu.native_bindings import NativeEngine
+from merklekv_tpu.utils.tracing import get_metrics, span
 
 __all__ = ["SyncManager", "SyncReport"]
 
@@ -81,10 +82,41 @@ class SyncManager:
 
     # -- one-shot ------------------------------------------------------------
     def sync_once(self, host: str, port: int) -> SyncReport:
+        with span("anti_entropy.sync_once", peer=f"{host}:{port}") as rec:
+            report = self._sync_once(host, port)
+            rec["divergent"] = report.divergent
+            get_metrics().inc("anti_entropy.syncs")
+            get_metrics().inc("anti_entropy.keys_repaired",
+                              report.set_keys + report.deleted_keys)
+            return report
+
+    def _sync_once(self, host: str, port: int) -> SyncReport:
         t0 = time.perf_counter()
         report = SyncReport(peer=f"{host}:{port}")
 
-        remote = self._fetch_remote(host, port)
+        with MerkleKVClient(host, port, timeout=self._timeout) as client:
+            # Root comparison first, on the same connection the snapshot
+            # would use: equal Merkle roots mean equal keyspaces, so no
+            # snapshot travels at all. (The reference documents a
+            # hash-compare walk but ships full-state transfer
+            # unconditionally — SURVEY §3.4.)
+            local_root = self._engine.merkle_root()
+            local_hex = local_root.hex() if local_root is not None else "0" * 64
+            try:
+                roots_equal = client.hash() == local_hex
+            except Exception as e:
+                # A peer that serves data but not HASH still syncs — but
+                # record the degradation instead of hiding it.
+                get_metrics().inc("anti_entropy.probe_failures")
+                report.details.append(f"hash probe failed: {e!r}")
+                roots_equal = False
+            if roots_equal:
+                report.seconds = time.perf_counter() - t0
+                report.details.append("roots equal; no transfer")
+                self.last_report = report
+                return report
+
+            remote = self._fetch_remote(client)
         local = {k: v for k, v in self._engine.snapshot()}
         report.remote_keys = len(remote)
         report.local_keys = len(local)
@@ -120,25 +152,24 @@ class SyncManager:
         self.last_report = report
         return report
 
-    def _fetch_remote(self, host: str, port: int) -> dict[bytes, bytes]:
-        """One connection: SCAN for keys, then MGET in batches."""
+    def _fetch_remote(self, c: MerkleKVClient) -> dict[bytes, bytes]:
+        """Snapshot over an already-open connection: SCAN, then batched MGET."""
         out: dict[bytes, bytes] = {}
-        with MerkleKVClient(host, port, timeout=self._timeout) as c:
-            keys = c.scan()
-            for i in range(0, len(keys), self._mget_batch):
-                batch = keys[i : i + self._mget_batch]
-                for k, v in c.mget(batch).items():
+        keys = c.scan()
+        for i in range(0, len(keys), self._mget_batch):
+            batch = keys[i : i + self._mget_batch]
+            for k, v in c.mget(batch).items():
+                if v is None:
+                    # MGET's wire format can't distinguish a missing key
+                    # from a literal "NOT_FOUND" value; GET can (the
+                    # "VALUE " prefix). The key came from SCAN, so only a
+                    # concurrent delete or that literal value lands here.
+                    v = c.get(k)
                     if v is None:
-                        # MGET's wire format can't distinguish a missing key
-                        # from a literal "NOT_FOUND" value; GET can (the
-                        # "VALUE " prefix). The key came from SCAN, so only a
-                        # concurrent delete or that literal value lands here.
-                        v = c.get(k)
-                        if v is None:
-                            continue
-                    out[k.encode("utf-8", "surrogateescape")] = v.encode(
-                        "utf-8", "surrogateescape"
-                    )
+                        continue
+                out[k.encode("utf-8", "surrogateescape")] = v.encode(
+                    "utf-8", "surrogateescape"
+                )
         return out
 
     # -- periodic loop ---------------------------------------------------------

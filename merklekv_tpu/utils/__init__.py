@@ -1,0 +1,10 @@
+"""Host-side utilities: structured tracing, metrics, device profiling."""
+
+from merklekv_tpu.utils.tracing import (
+    Metrics,
+    device_profile,
+    get_metrics,
+    span,
+)
+
+__all__ = ["span", "Metrics", "get_metrics", "device_profile"]

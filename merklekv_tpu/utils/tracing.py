@@ -1,0 +1,109 @@
+"""Structured tracing + metrics for the control plane.
+
+The reference has no tracing at all — just env_logger text logs, with
+structured tracing/Prometheus listed as an open roadmap issue
+(/root/reference/README.md:1902-1906). Here observability is first-class:
+
+- ``span("name")`` context manager: wall-time spans emitted as single-line
+  JSON records through the ``merklekv`` logger and aggregated into
+  per-span counters/totals;
+- ``get_metrics()``: process-wide registry (counters + span stats) that
+  subsystems (replicator, sync manager) bump; snapshot() for dashboards
+  and the test suite;
+- ``device_profile(logdir)``: wraps ``jax.profiler.trace`` so a TPU trace
+  of the Merkle data plane is one ``with`` block (inspect with
+  TensorBoard / xprof).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+logger = logging.getLogger("merklekv")
+
+__all__ = ["span", "Metrics", "get_metrics", "device_profile"]
+
+
+class Metrics:
+    """Thread-safe counters + span aggregates."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._span_count: dict[str, int] = {}
+        self._span_total_s: dict[str, float] = {}
+
+    def inc(self, name: str, delta: int = 1) -> None:
+        with self._mu:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def observe_span(self, name: str, seconds: float) -> None:
+        with self._mu:
+            self._span_count[name] = self._span_count.get(name, 0) + 1
+            self._span_total_s[name] = self._span_total_s.get(name, 0.0) + seconds
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "counters": dict(self._counters),
+                "spans": {
+                    name: {
+                        "count": self._span_count[name],
+                        "total_s": round(self._span_total_s[name], 6),
+                        "avg_s": round(
+                            self._span_total_s[name] / self._span_count[name], 6
+                        ),
+                    }
+                    for name in self._span_count
+                },
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._counters.clear()
+            self._span_count.clear()
+            self._span_total_s.clear()
+
+
+_metrics = Metrics()
+
+
+def get_metrics() -> Metrics:
+    return _metrics
+
+
+@contextmanager
+def span(name: str, **fields) -> Iterator[dict]:
+    """Timed span; yields a dict callers may stuff result fields into."""
+    extra: dict = {}
+    t0 = time.perf_counter()
+    error: Optional[str] = None
+    try:
+        yield extra
+    except BaseException as e:
+        error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        _metrics.observe_span(name, dt)
+        record = {"span": name, "seconds": round(dt, 6), **fields, **extra}
+        if error is not None:
+            record["error"] = error
+        logger.info(json.dumps(record, default=str))
+
+
+@contextmanager
+def device_profile(logdir: str) -> Iterator[None]:
+    """JAX profiler trace around a device workload (TensorBoard format)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

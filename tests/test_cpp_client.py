@@ -1,0 +1,76 @@
+"""Compile and run the C++ header-only client against the embedded server."""
+
+import os
+import subprocess
+import tempfile
+
+import pytest
+
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = r"""
+#include "merklekv_client.hpp"
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+
+int main(int argc, char** argv) {
+  mkvclient::Client c("127.0.0.1", uint16_t(std::atoi(argv[1])));
+  c.set("cppk", "cppv with spaces");
+  auto v = c.get("cppk");
+  assert(v && *v == "cppv with spaces");
+  assert(!c.get("missing"));
+  assert(c.increment("n", 5) == 5);
+  assert(c.decrement("n", 2) == 3);
+  assert(c.append("s", "ab") == "ab");
+  assert(c.prepend("s", "x") == "xab");
+  auto keys = c.scan();
+  assert(keys.size() == 3);
+  assert(c.dbsize() == 3);
+  assert(c.hash().size() == 64);
+  assert(c.ping());
+  assert(c.echo("hello") == "hello");
+  auto out = c.pipeline({"SET p1 a", "SET p2 b", "GET p1"});
+  assert(out[0] == "OK" && out[2] == "VALUE a");
+  bool threw = false;
+  try { c.request("NOSUCH x"); } catch (const mkvclient::ProtocolError&) { threw = true; }
+  assert(threw);
+  assert(c.del("cppk"));
+  assert(!c.del("cppk"));
+  std::puts("CPP CLIENT OK");
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def driver_bin():
+    d = tempfile.mkdtemp()
+    src = os.path.join(d, "driver.cc")
+    out = os.path.join(d, "driver")
+    with open(src, "w") as f:
+        f.write(DRIVER)
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-Wall",
+         "-I", os.path.join(REPO, "clients", "cpp"), src, "-o", out],
+        check=True, capture_output=True,
+    )
+    return out
+
+
+def test_cpp_client_end_to_end(driver_bin):
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    try:
+        r = subprocess.run(
+            [driver_bin, str(srv.port)], capture_output=True, text=True,
+            timeout=30,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "CPP CLIENT OK" in r.stdout
+    finally:
+        srv.close()
+        eng.close()

@@ -1,0 +1,117 @@
+"""Incremental device Merkle state vs the golden CPU tree."""
+
+import numpy as np
+import pytest
+
+from merklekv_tpu.merkle.cpu import MerkleTree
+from merklekv_tpu.merkle.incremental import DeviceMerkleState
+
+
+def cpu_root(values: dict[bytes, bytes]):
+    t = MerkleTree()
+    for k, v in values.items():
+        t.insert(k.decode(), v.decode())
+    return t.root_hash()
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 37, 64, 100])
+def test_initial_build_matches_cpu(n):
+    items = {b"ik%04d" % i: b"iv%d" % (i * 3) for i in range(n)}
+    st = DeviceMerkleState.from_items(items.items())
+    assert st.root_hash() == cpu_root(items)
+
+
+def test_empty_state():
+    st = DeviceMerkleState()
+    assert st.root_hash() is None
+    assert st.root_hex() == "0" * 64
+
+
+def test_value_updates_are_incremental():
+    items = {b"uk%04d" % i: b"v%d" % i for i in range(53)}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()  # initial build
+    assert st.full_rebuilds == 1
+
+    # Several rounds of in-place value updates: no further rebuilds.
+    rng = np.random.RandomState(5)
+    for round_ in range(4):
+        ks = [b"uk%04d" % i for i in rng.choice(53, size=7, replace=False)]
+        changes = [(k, b"new-%d-%d" % (round_, i)) for i, k in enumerate(ks)]
+        for k, v in changes:
+            items[k] = v
+        st.apply(changes)
+        assert st.root_hash() == cpu_root(items)
+    assert st.full_rebuilds == 1
+    assert st.incremental_batches == 4
+
+
+def test_single_key_update():
+    items = {b"a": b"1", b"b": b"2", b"c": b"3"}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    items[b"b"] = b"changed"
+    st.apply([(b"b", b"changed")])
+    assert st.root_hash() == cpu_root(items)
+    assert st.full_rebuilds == 1
+
+
+def test_insert_triggers_rebuild():
+    items = {b"a": b"1", b"b": b"2"}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    items[b"aa"] = b"between"  # shifts sorted positions
+    st.apply([(b"aa", b"between")])
+    assert st.root_hash() == cpu_root(items)
+    assert st.full_rebuilds == 2
+
+
+def test_delete_triggers_rebuild():
+    items = {b"a": b"1", b"b": b"2", b"c": b"3"}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    del items[b"b"]
+    st.apply([(b"b", None)])
+    assert st.root_hash() == cpu_root(items)
+    assert st.full_rebuilds == 2
+
+
+def test_mixed_batch_update_then_insert():
+    items = {b"mk%03d" % i: b"v%d" % i for i in range(20)}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    # Batch mixing in-place updates with an insert: correctness first.
+    changes = [(b"mk005", b"x5"), (b"zz-new", b"nv"), (b"mk011", b"x11")]
+    items[b"mk005"] = b"x5"
+    items[b"zz-new"] = b"nv"
+    items[b"mk011"] = b"x11"
+    st.apply(changes)
+    assert st.root_hash() == cpu_root(items)
+
+
+def test_update_missing_key_is_insert():
+    st = DeviceMerkleState.from_items([(b"k", b"v")])
+    st.root_hash()
+    st.apply([(b"new", b"nv")])
+    assert st.root_hash() == cpu_root({b"k": b"v", b"new": b"nv"})
+
+
+def test_capacity_padding_at_non_pow2_counts():
+    # n just below / at / above powers of two exercises the promotion walk.
+    for n in (31, 32, 33, 63, 65):
+        items = {b"pk%04d" % i: b"pv%d" % i for i in range(n)}
+        st = DeviceMerkleState.from_items(items.items())
+        assert st.root_hash() == cpu_root(items), n
+        # and after an in-place update
+        items[b"pk%04d" % (n // 2)] = b"mut"
+        st.apply([(b"pk%04d" % (n // 2), b"mut")])
+        assert st.root_hash() == cpu_root(items), n
+
+
+def test_leaf_digest_view():
+    from merklekv_tpu.merkle.encoding import leaf_hash
+
+    st = DeviceMerkleState.from_items([(b"k1", b"v1"), (b"k2", b"v2")])
+    st.root_hash()
+    assert st.leaf_digest(b"k1") == leaf_hash(b"k1", b"v1")
+    assert st.leaf_digest(b"missing") is None

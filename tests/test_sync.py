@@ -57,6 +57,9 @@ def test_sync_identical_is_noop(two_nodes):
     )
     assert report.divergent == 0
     assert report.set_keys == report.deleted_keys == 0
+    # Equal roots short-circuit before any snapshot transfer.
+    assert report.details == ["roots equal; no transfer"]
+    assert report.remote_keys == 0  # never fetched
 
 
 def test_sync_empty_remote_clears_local(two_nodes):

@@ -1,0 +1,69 @@
+"""Tracing spans + metrics registry."""
+
+import json
+import logging
+
+import pytest
+
+from merklekv_tpu.utils.tracing import Metrics, get_metrics, span
+
+
+def test_span_emits_json_and_aggregates(caplog):
+    m = get_metrics()
+    m.reset()
+    with caplog.at_level(logging.INFO, logger="merklekv"):
+        with span("test.op", peer="p1") as rec:
+            rec["items"] = 3
+    records = [json.loads(r.message) for r in caplog.records]
+    assert records and records[0]["span"] == "test.op"
+    assert records[0]["peer"] == "p1"
+    assert records[0]["items"] == 3
+    assert records[0]["seconds"] >= 0
+    snap = m.snapshot()
+    assert snap["spans"]["test.op"]["count"] == 1
+
+
+def test_span_records_errors(caplog):
+    get_metrics().reset()
+    with caplog.at_level(logging.INFO, logger="merklekv"):
+        with pytest.raises(ValueError):
+            with span("test.fail"):
+                raise ValueError("boom")
+    rec = json.loads(caplog.records[0].message)
+    assert rec["error"] == "ValueError: boom"
+
+
+def test_metrics_counters_thread_safe():
+    import threading
+
+    m = Metrics()
+
+    def bump():
+        for _ in range(1000):
+            m.inc("x")
+
+    ts = [threading.Thread(target=bump) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert m.snapshot()["counters"]["x"] == 8000
+
+
+def test_sync_manager_emits_metrics():
+    from merklekv_tpu.cluster.sync import SyncManager
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    get_metrics().reset()
+    with NativeEngine("mem") as remote_eng:
+        remote_eng.set(b"mk", b"mv")
+        with NativeServer(remote_eng, "127.0.0.1", 0) as srv:
+            srv.start()
+            with NativeEngine("mem") as local_eng:
+                SyncManager(local_eng, device="cpu").sync_once(
+                    "127.0.0.1", srv.port
+                )
+    snap = get_metrics().snapshot()
+    assert snap["counters"]["anti_entropy.syncs"] == 1
+    assert snap["counters"]["anti_entropy.keys_repaired"] == 1
+    assert snap["spans"]["anti_entropy.sync_once"]["count"] == 1
