@@ -237,6 +237,16 @@ class MerkleKVClient:
             raise ProtocolError(f"unexpected response: {resp}")
         return resp.rsplit(" ", 1)[-1]
 
+    def leaf_hashes(self, prefix: str = "") -> dict[str, str]:
+        """Per-key leaf digests (hex) — the anti-entropy narrowing fetch."""
+        cmd = f"LEAFHASHES {prefix}" if prefix else "LEAFHASHES"
+        n = _count_after(self._request(cmd), "HASHES ")
+        out: dict[str, str] = {}
+        for _ in range(n):
+            k, _, h = self._read_line().rpartition(" ")
+            out[k] = h
+        return out
+
     # -- admin ---------------------------------------------------------------
     def ping(self, message: str = "") -> str:
         cmd = f"PING {message}" if message else "PING"
@@ -267,15 +277,22 @@ class MerkleKVClient:
         return self._read_kv_block()
 
     def _read_kv_block(self) -> dict[str, str]:
-        # Stats/info blocks have no terminator; they are a fixed set of
-        # `name:value` lines. Read until the buffered stream drains: issue a
-        # PING sentinel to delimit.
+        # Stats/info blocks are `name:value` lines closed by an END
+        # terminator (same shape as CLIENT LIST). Servers that predate the
+        # terminator (reference parity mode / rolling upgrade) never send
+        # END, so a PING sentinel is pipelined as a fallback delimiter; on
+        # an END-speaking server the sentinel's PONG is consumed right
+        # after the block.
         self._send_line("PING __end__")
         out: dict[str, str] = {}
         while True:
             line = self._read_line()
-            if line == "PONG __end__":
+            if line == "END":
+                while self._read_line() != "PONG __end__":
+                    pass  # drain to the sentinel reply
                 return out
+            if line == "PONG __end__":
+                return out  # terminator-less server
             name, _, value = line.partition(":")
             out[name] = value
 

@@ -46,6 +46,10 @@ class OpKind(str, Enum):
     DECR = "decr"
     APPEND = "append"
     PREPEND = "prepend"
+    # Local extension: staged by the native server for device-mirror
+    # invalidation; never published on the wire (the reference replicates
+    # only the six ops above, replication.rs:197-254).
+    TRUNCATE = "truncate"
 
 
 @dataclass

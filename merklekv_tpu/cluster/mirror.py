@@ -1,0 +1,208 @@
+"""DeviceTreeMirror: a live device-resident Merkle tree behind serving HASH.
+
+The reference recomputes its tree from scratch on demand (HASH scans and
+rehashes every leaf, server.rs:647-684) and never feeds writes into the tree
+(TODO at replication.rs:312-316). Here the native server stages every write
+into the event queue; the replicator drains them and this mirror applies the
+batches to a ``DeviceMerkleState`` — value updates are O(k log C) scatters on
+device, so a warm HASH answer costs one promotion-chain walk instead of an
+O(n) rehash.
+
+Consistency model: the mirror trails the engine by at most one drain
+interval; ``ClusterNode.device_root_hex`` flushes the replicator first, so a
+client that observed its write's response sees a root that includes it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind
+from merklekv_tpu.native_bindings import NativeEngine
+
+__all__ = ["DeviceTreeMirror"]
+
+
+class DeviceTreeMirror:
+    def __init__(self, engine: NativeEngine) -> None:
+        self._engine = engine
+        self._mu = threading.RLock()
+        self._state = None  # lazy: built from an engine snapshot on first use
+        self._warming = threading.Event()
+        self._warm_thread: Optional[threading.Thread] = None
+        self._closed = False
+        # While a warm build runs outside the lock, writes landing meanwhile
+        # are recorded here (keys only) and replayed against the engine's
+        # current values when the built state is swapped in.
+        self._pending: Optional[set] = None
+        self._pending_truncate = False
+
+    # -- warm-up -------------------------------------------------------------
+    def ready(self) -> bool:
+        return self._state is not None
+
+    def invalidate(self) -> None:
+        """Throw the device state away (e.g. after a failed batch apply);
+        the next HASH request answers natively and triggers a re-warm."""
+        with self._mu:
+            self._state = None
+            self._pending = None
+        self._warming.clear()
+
+    def close(self) -> None:
+        """Stop using the engine. MUST be called before the native engine is
+        destroyed — the warm thread snapshots through its raw pointer."""
+        with self._mu:
+            self._closed = True
+        t = self._warm_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=30)
+
+    def start_warming(self) -> None:
+        """Build the device state off the serving path.
+
+        The first device use pays jax import + kernel compile (seconds);
+        HASH must not stall behind it, so the server keeps answering from
+        the native path until ``ready()``. The build runs OUTSIDE the
+        mirror lock — holding it would stall the replicator drain loop and
+        inbound LWW applies for the whole compile. Writes landing during
+        the build are recorded (keys only) and replayed from the engine's
+        current values at swap-in; a truncate mid-build restarts it.
+        """
+        if self._warming.is_set():
+            return
+        self._warming.set()
+
+        def warm() -> None:
+            try:
+                for _attempt in range(3):
+                    with self._mu:
+                        if self._state is not None or self._closed:
+                            return
+                        self._pending = set()
+                        self._pending_truncate = False
+                        items = self._engine.snapshot()
+                    cls = self._device_state_cls()
+                    st = cls.from_items(items)
+                    # Pay the build + kernel-compile cost HERE so the first
+                    # post-warm HASH answers immediately.
+                    st.root_hex()
+                    with self._mu:
+                        if self._closed:
+                            return
+                        if self._pending_truncate:
+                            self._pending = None
+                            continue  # keyspace vanished mid-build; redo
+                        pend, self._pending = self._pending, None
+                        if pend:
+                            st.apply(
+                                [(k, self._engine.get(k)) for k in pend]
+                            )
+                        self._state = st
+                        return
+            except Exception:
+                pass
+            self._warming.clear()  # allow a retry
+
+        self._warm_thread = threading.Thread(
+            target=warm, daemon=True, name="mkv-mirror-warm"
+        )
+        self._warm_thread.start()
+
+    # -- event feeds ---------------------------------------------------------
+    def on_events(self, events: list[ChangeEvent]) -> None:
+        """Local writes, drained from the native event queue in batches.
+
+        The event's payload value is deliberately ignored: local events
+        arrive asynchronously (drain thread) while remote LWW applies land
+        inline, so replaying stale payloads could leave the mirror on an
+        older value than the engine. Re-reading the engine's CURRENT value
+        for each touched key makes every batch a convergence step — any
+        write racing the read stages its own later event.
+        """
+        with self._mu:
+            if self._closed:
+                return
+            if self._state is None:
+                self._note_pending(
+                    (ev.key.encode("utf-8", "surrogateescape")
+                     if ev.op is not OpKind.TRUNCATE else None)
+                    for ev in events
+                )
+                return
+            touched: dict[bytes, None] = {}
+            for ev in events:
+                if ev.op is OpKind.TRUNCATE:
+                    # Everything before the truncate is dead.
+                    touched.clear()
+                    self._state = self._empty_state()
+                    continue
+                touched[ev.key.encode("utf-8", "surrogateescape")] = None
+            if touched:
+                self._state.apply(
+                    [(k, self._engine.get(k)) for k in touched]
+                )
+
+    def apply_one(self, key: bytes, value: Optional[bytes]) -> None:
+        """Remote writes, applied inline by the LWW applier."""
+        with self._mu:
+            if self._closed:
+                return
+            if self._state is None:
+                self._note_pending([key])
+                return
+            self._state.apply([(key, value)])
+
+    def _note_pending(self, keys) -> None:
+        """Record writes landing during a warm build (lock held by caller).
+        A None entry marks a truncate, which invalidates the whole build."""
+        if self._pending is None:
+            return  # no build in flight; the eventual snapshot covers these
+        for k in keys:
+            if k is None:
+                self._pending_truncate = True
+                self._pending.clear()
+            else:
+                self._pending.add(k)
+
+    # -- queries -------------------------------------------------------------
+    def root_hex(self) -> str:
+        with self._mu:
+            if self._closed:
+                raise RuntimeError("mirror closed")
+            if self._state is None:
+                self._state = self._load_state()
+            return self._state.root_hex()
+
+    @property
+    def state(self):
+        return self._state
+
+    # -- internals -----------------------------------------------------------
+    @staticmethod
+    def _device_state_cls():
+        # MERKLEKV_JAX_PLATFORM lets multi-process harnesses pin server
+        # processes to jax-on-CPU: the environment's sitecustomize pins jax
+        # to the tunneled TPU, which is single-process — N spawned servers
+        # must not race for it. Must run before any jax backend initializes,
+        # hence here on the first device use, not at module import.
+        import os
+
+        plat = os.environ.get("MERKLEKV_JAX_PLATFORM")
+        if plat:
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", plat)
+            except RuntimeError:
+                pass  # backend already initialized; keep whatever it is
+        from merklekv_tpu.merkle.incremental import DeviceMerkleState
+
+        return DeviceMerkleState
+
+    def _load_state(self):
+        return self._device_state_cls().from_items(self._engine.snapshot())
+
+    def _empty_state(self):
+        return self._device_state_cls()()

@@ -35,8 +35,13 @@ class ClusterNode:
         self._transport = transport
         self._owns_transport = transport is None
         self._replicator: Optional[Replicator] = None
+        self._mirror = None  # DeviceTreeMirror, alive while replication is on
         self._rep_mu = threading.Lock()
-        self.sync_manager = SyncManager(engine, device=cfg.anti_entropy.engine)
+        self.sync_manager = SyncManager(
+            engine,
+            device=cfg.anti_entropy.engine,
+            repair_listener=self._on_sync_repair,
+        )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -54,10 +59,7 @@ class ClusterNode:
 
     def stop(self) -> None:
         self.sync_manager.stop()
-        with self._rep_mu:
-            if self._replicator is not None:
-                self._replicator.stop()
-                self._replicator = None
+        self._disable_replication()
         if self._owns_transport and self._transport is not None:
             self._transport.close()
             self._transport = None
@@ -82,12 +84,21 @@ class ClusterNode:
                 transport = self._get_transport()
             except OSError as e:
                 return f"broker unreachable: {e}"
+            # The mirror is only trustworthy while the event queue feeds it,
+            # i.e. while replication is enabled — so its lifecycle is tied
+            # to the replicator's. "cpu" pins anti-entropy (and HASH) to the
+            # host path; anything else serves HASH from the device tree.
+            if self._cfg.anti_entropy.engine != "cpu":
+                from merklekv_tpu.cluster.mirror import DeviceTreeMirror
+
+                self._mirror = DeviceTreeMirror(self._engine)
             self._replicator = Replicator(
                 self._engine,
                 self._server,
                 transport,
                 topic_prefix=self._cfg.replication.topic_prefix,
                 node_id=self._cfg.replication.client_id,
+                mirror=self._mirror,
             )
             self._replicator.start()
             return None
@@ -97,14 +108,54 @@ class ClusterNode:
             if self._replicator is not None:
                 self._replicator.stop()
                 self._replicator = None
+            if self._mirror is not None:
+                # Before any teardown of the native engine: the mirror's
+                # warm thread reads through the engine's raw pointer.
+                self._mirror.close()
+                self._mirror = None
+
+    def _on_sync_repair(self, key: bytes, value) -> None:
+        """Anti-entropy repairs bypass the server event queue; feed the
+        device mirror directly so HASH stays truthful after a SYNC."""
+        with self._rep_mu:
+            mirror = self._mirror
+        if mirror is not None:
+            mirror.apply_one(key, value)
+
+    def device_root_hex(self) -> Optional[str]:
+        """Whole-keyspace Merkle root from the live device tree, or None
+        when the mirror isn't ready (replication off / device disabled /
+        still warming — the native path answers meanwhile)."""
+        with self._rep_mu:
+            rep, mirror = self._replicator, self._mirror
+        if rep is None or mirror is None:
+            return None
+        if not mirror.ready():
+            mirror.start_warming()  # no-op if already in flight
+            return None
+        try:
+            rep.flush()  # read-your-writes: drain staged events first
+            return mirror.root_hex()
+        except Exception:
+            return None  # native fallback answers instead
 
     # -- cluster command callback ---------------------------------------------
     def _on_cluster_command(self, line: str) -> Optional[str]:
         parts = line.split()
+        if parts[0] == "HASH":
+            # Whole-keyspace root served from the device-resident
+            # incremental tree; empty answer falls back to the native path.
+            root = self.device_root_hex()
+            return f"HASH {root}\r\n" if root is not None else None
         if parts[0] == "SYNC":
             host, port = parts[1], int(parts[2])
             try:
-                self.sync_manager.sync_once(host, port)
+                self.sync_manager.sync_once(
+                    host,
+                    port,
+                    full="--full" in parts,
+                    verify="--verify" in parts,
+                )
                 return "OK\r\n"
             except Exception as e:
                 return f"ERROR {e}\r\n"

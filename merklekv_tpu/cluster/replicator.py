@@ -37,6 +37,7 @@ from merklekv_tpu.native_bindings import (
     OP_INCR,
     OP_PREPEND,
     OP_SET,
+    OP_TRUNCATE,
     ChangeEventRaw,
     NativeEngine,
     NativeServer,
@@ -51,6 +52,7 @@ _OP_MAP = {
     OP_DECR: OpKind.DECR,
     OP_APPEND: OpKind.APPEND,
     OP_PREPEND: OpKind.PREPEND,
+    OP_TRUNCATE: OpKind.TRUNCATE,
 }
 
 
@@ -64,6 +66,7 @@ class Replicator:
         node_id: str = "",
         drain_interval: float = 0.005,
         batch_listener: Optional[Callable[[list[ChangeEvent]], None]] = None,
+        mirror=None,  # Optional[DeviceTreeMirror]
     ) -> None:
         self._engine = engine
         self._server = server
@@ -72,13 +75,32 @@ class Replicator:
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:12]}"
         self._drain_interval = drain_interval
         self._batch_listener = batch_listener
-        self._applier = LWWApplier(engine.set, lambda k: engine.delete(k))
+        self._mirror = mirror
+        if mirror is None:
+            self._applier = LWWApplier(engine.set, lambda k: engine.delete(k))
+        else:
+            # Remote applies bypass the server's event queue (no echo loop),
+            # so the device mirror must be fed inline here.
+            def _set(k: bytes, v: bytes) -> None:
+                engine.set(k, v)
+                mirror.apply_one(k, v)
+
+            def _del(k: bytes) -> None:
+                engine.delete(k)
+                mirror.apply_one(k, None)
+
+            self._applier = LWWApplier(_set, _del)
         self._applier_mu = threading.Lock()
+        # Spans drain..mirror-apply: a flush() must not return while another
+        # thread holds drained-but-unapplied events, or device_root_hex's
+        # read-your-writes guarantee breaks.
+        self._flush_mu = threading.Lock()
         self._stop = threading.Event()
         self._drain_thread: Optional[threading.Thread] = None
         self.published = 0
         self.received = 0
         self.decode_errors = 0
+        self.publish_errors = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -101,19 +123,40 @@ class Replicator:
     # -- outbound -----------------------------------------------------------
     def flush(self) -> int:
         """Drain and publish pending native write events once."""
-        raws = self._server.drain_events()
-        if not raws:
-            return 0
-        events = [self._to_event(r) for r in raws]
-        for ev in events:
-            self._transport.publish(self._topic, encode_cbor(ev))
-        self.published += len(events)
-        if self._batch_listener is not None:
-            try:
-                self._batch_listener(events)
-            except Exception:
-                pass
-        return len(events)
+        with self._flush_mu:
+            raws = self._server.drain_events()
+            if not raws:
+                return 0
+            events = [self._to_event(r) for r in raws]
+            # Mirror first: once events leave the native queue they are the
+            # mirror's only chance to see these keys — a publish failure
+            # must not cost the mirror the batch.
+            if self._mirror is not None:
+                try:
+                    self._mirror.on_events(events)
+                except Exception:
+                    # Device trouble: a silently-dropped batch would serve a
+                    # divergent root forever; invalidate so HASH falls back
+                    # to the native path until a re-warm succeeds.
+                    self._mirror.invalidate()
+            published = 0
+            for ev in events:
+                # TRUNCATE stays local: it only invalidates device mirrors.
+                if ev.op is OpKind.TRUNCATE:
+                    continue
+                try:
+                    self._transport.publish(self._topic, encode_cbor(ev))
+                    published += 1
+                except Exception:
+                    # QoS-0 fabric: drop and count; anti-entropy repairs.
+                    self.publish_errors += 1
+            self.published += published
+            if self._batch_listener is not None:
+                try:
+                    self._batch_listener(events)
+                except Exception:
+                    pass
+            return len(events)
 
     def _drain_loop(self) -> None:
         while not self._stop.is_set():

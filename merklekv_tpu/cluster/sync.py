@@ -16,6 +16,18 @@ sync.rs:104-119) and every remote key is GET over a FRESH TCP connection
 
 Semantics match sync_once: one-way local := remote for every divergent key
 (sync.rs:74-83), including deletion of local-only keys.
+
+Transfer strategy (the fix for the reference's core flaw — its README
+documents an O(log n) hash-walk, README.md:310-372, but the code ships the
+entire keyspace as values on every divergence, sync.rs:150-214):
+
+  1. root compare — equal roots, zero transfer;
+  2. LEAFHASHES — fetch per-key digests (32 bytes/key, not values), diff,
+     then MGET only the divergent keys; bandwidth is proportional to
+     divergence, not keyspace size;
+  3. ``--full`` (or a peer without LEAFHASHES) — full snapshot transfer,
+     the reference behavior, kept as an explicit escape hatch;
+  4. ``--verify`` — re-compare Merkle roots after repair; mismatch raises.
 """
 
 from __future__ import annotations
@@ -44,6 +56,9 @@ class SyncReport:
     divergent: int = 0
     set_keys: int = 0
     deleted_keys: int = 0
+    values_fetched: int = 0  # values transferred (== divergent when hash-first)
+    mode: str = ""  # "noop" | "hash-first" | "full" | "full-fallback"
+    verified: Optional[bool] = None  # post-sync root recheck (--verify)
     seconds: float = 0.0
     details: list[str] = field(default_factory=list)
 
@@ -71,26 +86,35 @@ class SyncManager:
         device: str = "auto",  # "auto" | "cpu" | "tpu"
         mget_batch: int = 512,
         timeout: float = 30.0,
+        repair_listener=None,  # Callable[[bytes, Optional[bytes]], None]
     ) -> None:
         self._engine = engine
         self._device = device
         self._mget_batch = mget_batch
         self._timeout = timeout
+        # Repairs write through the engine bindings, bypassing the server's
+        # event queue — anything mirroring the keyspace (the device Merkle
+        # tree) must be told explicitly or it serves stale roots forever.
+        self._repair_listener = repair_listener
         self._loop_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_report: Optional[SyncReport] = None
 
     # -- one-shot ------------------------------------------------------------
-    def sync_once(self, host: str, port: int) -> SyncReport:
+    def sync_once(
+        self, host: str, port: int, full: bool = False, verify: bool = False
+    ) -> SyncReport:
         with span("anti_entropy.sync_once", peer=f"{host}:{port}") as rec:
-            report = self._sync_once(host, port)
+            report = self._sync_once(host, port, full, verify)
             rec["divergent"] = report.divergent
             get_metrics().inc("anti_entropy.syncs")
             get_metrics().inc("anti_entropy.keys_repaired",
                               report.set_keys + report.deleted_keys)
             return report
 
-    def _sync_once(self, host: str, port: int) -> SyncReport:
+    def _sync_once(
+        self, host: str, port: int, full: bool, verify: bool
+    ) -> SyncReport:
         t0 = time.perf_counter()
         report = SyncReport(peer=f"{host}:{port}")
 
@@ -111,59 +135,166 @@ class SyncManager:
                 report.details.append(f"hash probe failed: {e!r}")
                 roots_equal = False
             if roots_equal:
+                report.mode = "noop"
+                report.verified = True if verify else None
                 report.seconds = time.perf_counter() - t0
                 report.details.append("roots equal; no transfer")
                 self.last_report = report
                 return report
 
-            remote = self._fetch_remote(client)
-        local = {k: v for k, v in self._engine.snapshot()}
-        report.remote_keys = len(remote)
-        report.local_keys = len(local)
-
-        n_union = len(set(local) | set(remote))
-        use_device = (
-            self._device == "tpu"
-            or (self._device == "auto" and n_union >= _DEVICE_THRESHOLD)
-        )
-        local_hashes = _leaf_map(sorted(local.items()), use_device)
-        remote_hashes = _leaf_map(sorted(remote.items()), use_device)
-
-        if use_device:
-            from merklekv_tpu.merkle.diff import diff_keys_pair
-
-            divergent = diff_keys_pair(local_hashes, remote_hashes)
-        else:
-            keys = set(local_hashes) | set(remote_hashes)
-            divergent = sorted(
-                k for k in keys if local_hashes.get(k) != remote_hashes.get(k)
-            )
-        report.divergent = len(divergent)
-
-        for k in divergent:
-            if k in remote:
-                self._engine.set(k, remote[k])
-                report.set_keys += 1
+            if full:
+                report.mode = "full"
+                self._sync_full(client, report)
             else:
-                self._engine.delete(k)
-                report.deleted_keys += 1
+                remote_hashes = self._fetch_remote_hashes(client, report)
+                if remote_hashes is None:
+                    report.mode = "full-fallback"
+                    self._sync_full(client, report)
+                else:
+                    report.mode = "hash-first"
+                    self._sync_hash_first(client, remote_hashes, report)
+
+            if verify:
+                local_root = self._engine.merkle_root()
+                local_hex = (
+                    local_root.hex() if local_root is not None else "0" * 64
+                )
+                report.verified = client.hash() == local_hex
+                if not report.verified:
+                    get_metrics().inc("anti_entropy.verify_failures")
+                    report.seconds = time.perf_counter() - t0
+                    self.last_report = report
+                    raise RuntimeError(
+                        f"sync verify failed: roots differ after repair "
+                        f"(peer {report.peer})"
+                    )
 
         report.seconds = time.perf_counter() - t0
         self.last_report = report
         return report
 
+    # -- hash-first path ------------------------------------------------------
+    def _fetch_remote_hashes(
+        self, client: MerkleKVClient, report: SyncReport
+    ) -> Optional[dict[bytes, bytes]]:
+        """Peer leaf digests, or None if the peer can't serve LEAFHASHES."""
+        try:
+            raw = client.leaf_hashes()
+        except Exception as e:
+            report.details.append(f"LEAFHASHES unsupported: {e!r}")
+            get_metrics().inc("anti_entropy.leafhash_fallbacks")
+            return None
+        return {
+            k.encode("utf-8", "surrogateescape"): bytes.fromhex(h)
+            for k, h in raw.items()
+        }
+
+    def _sync_hash_first(
+        self,
+        client: MerkleKVClient,
+        remote_hashes: dict[bytes, bytes],
+        report: SyncReport,
+    ) -> None:
+        local = {k: v for k, v in self._engine.snapshot()}
+        report.remote_keys = len(remote_hashes)
+        report.local_keys = len(local)
+
+        use_device = self._use_device(len(set(local) | set(remote_hashes)))
+        local_hashes = _leaf_map(sorted(local.items()), use_device)
+        divergent = self._diff(local_hashes, remote_hashes, use_device)
+        report.divergent = len(divergent)
+
+        to_fetch = [k for k in divergent if k in remote_hashes]
+        values = self._fetch_values(client, to_fetch)
+        report.values_fetched = len(values)
+        for k in divergent:
+            if k in remote_hashes:
+                if k in values:
+                    self._repair_set(k, values[k])
+                    report.set_keys += 1
+                # else: deleted on the peer between LEAFHASHES and MGET;
+                # the next cycle repairs it.
+            else:
+                self._repair_delete(k)
+                report.deleted_keys += 1
+
+    # -- full path (reference behavior; --full or LEAFHASHES-less peer) -------
+    def _sync_full(self, client: MerkleKVClient, report: SyncReport) -> None:
+        remote = self._fetch_remote(client)
+        local = {k: v for k, v in self._engine.snapshot()}
+        report.remote_keys = len(remote)
+        report.local_keys = len(local)
+        report.values_fetched = len(remote)
+
+        use_device = self._use_device(len(set(local) | set(remote)))
+        local_hashes = _leaf_map(sorted(local.items()), use_device)
+        remote_hashes = _leaf_map(sorted(remote.items()), use_device)
+        divergent = self._diff(local_hashes, remote_hashes, use_device)
+        report.divergent = len(divergent)
+
+        for k in divergent:
+            if k in remote:
+                self._repair_set(k, remote[k])
+                report.set_keys += 1
+            else:
+                self._repair_delete(k)
+                report.deleted_keys += 1
+
+    def _repair_set(self, k: bytes, v: bytes) -> None:
+        self._engine.set(k, v)
+        if self._repair_listener is not None:
+            self._repair_listener(k, v)
+
+    def _repair_delete(self, k: bytes) -> None:
+        self._engine.delete(k)
+        if self._repair_listener is not None:
+            self._repair_listener(k, None)
+
+    def _use_device(self, n_union: int) -> bool:
+        return self._device == "tpu" or (
+            self._device == "auto" and n_union >= _DEVICE_THRESHOLD
+        )
+
+    def _diff(
+        self,
+        local_hashes: dict[bytes, bytes],
+        remote_hashes: dict[bytes, bytes],
+        use_device: bool,
+    ) -> list[bytes]:
+        if use_device:
+            from merklekv_tpu.merkle.diff import diff_keys_pair
+
+            return diff_keys_pair(local_hashes, remote_hashes)
+        keys = set(local_hashes) | set(remote_hashes)
+        return sorted(
+            k for k in keys if local_hashes.get(k) != remote_hashes.get(k)
+        )
+
     def _fetch_remote(self, c: MerkleKVClient) -> dict[bytes, bytes]:
         """Snapshot over an already-open connection: SCAN, then batched MGET."""
+        return self._mget_all(c, c.scan())
+
+    def _fetch_values(
+        self, c: MerkleKVClient, keys: list[bytes]
+    ) -> dict[bytes, bytes]:
+        """Targeted value fetch for the divergent set only."""
+        return self._mget_all(
+            c, [k.decode("utf-8", "surrogateescape") for k in keys]
+        )
+
+    def _mget_all(
+        self, c: MerkleKVClient, keys: list[str]
+    ) -> dict[bytes, bytes]:
         out: dict[bytes, bytes] = {}
-        keys = c.scan()
         for i in range(0, len(keys), self._mget_batch):
             batch = keys[i : i + self._mget_batch]
             for k, v in c.mget(batch).items():
                 if v is None:
                     # MGET's wire format can't distinguish a missing key
                     # from a literal "NOT_FOUND" value; GET can (the
-                    # "VALUE " prefix). The key came from SCAN, so only a
-                    # concurrent delete or that literal value lands here.
+                    # "VALUE " prefix). The key came from SCAN/LEAFHASHES,
+                    # so only a concurrent delete or that literal value
+                    # lands here.
                     v = c.get(k)
                     if v is None:
                         continue

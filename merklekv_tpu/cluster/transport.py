@@ -103,13 +103,20 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
+# Frames above this are rejected before any allocation: the header's 4-byte
+# total is peer-controlled and must not size a buffer unchecked.
+MAX_FRAME = 16 << 20
+
+
 def _read_frame(sock: socket.socket) -> Optional[tuple[str, bytes]]:
     head = _read_exact(sock, 6)
     if head is None:
         return None
     total, tlen = struct.unpack("<IH", head)
+    if tlen > total or total > MAX_FRAME:
+        return None
     body = _read_exact(sock, total)
-    if body is None or tlen > total:
+    if body is None:
         return None
     return body[:tlen].decode("utf-8"), body[tlen:]
 

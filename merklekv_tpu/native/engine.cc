@@ -220,6 +220,13 @@ LogEngine::LogEngine(const std::string& dir) {
   path_ = dir + "/data.log";
   int rfd = ::open(path_.c_str(), O_RDONLY);
   if (rfd >= 0) {
+    // Byte offset just past the last fully-replayed record. Anything after
+    // it (a torn or corrupt tail) must be cut before reopening O_APPEND —
+    // otherwise post-recovery writes land after the corrupt bytes and every
+    // future replay silently drops them.
+    const off_t end = ::lseek(rfd, 0, SEEK_END);
+    ::lseek(rfd, 0, SEEK_SET);
+    off_t good = 0;
     for (;;) {
       uint8_t op;
       uint32_t klen, vlen;
@@ -227,7 +234,11 @@ LogEngine::LogEngine(const std::string& dir) {
           !read_exact(rfd, &vlen, 4)) {
         break;
       }
-      if (klen > (64u << 20) || vlen > (64u << 20)) break;  // corrupt tail
+      // Torn-tail test by exact arithmetic, not a size cap: a record whose
+      // claimed payload runs past the end of the file cannot be complete
+      // (and allocating from a garbage length would be an OOM hazard).
+      // Legitimately large records replay fine.
+      if (off_t(9) + off_t(klen) + off_t(vlen) > end - good) break;
       std::string key(klen, '\0'), value(vlen, '\0');
       if (klen && !read_exact(rfd, key.data(), klen)) break;
       if (vlen && !read_exact(rfd, value.data(), vlen)) break;
@@ -238,10 +249,14 @@ LogEngine::LogEngine(const std::string& dir) {
       } else if (op == kOpTruncate) {
         mem_.truncate();
       } else {
+        // Unknown op: this format has no forward-compat records (v1 writes
+        // only 1..3), so these bytes are corruption and get cut too.
         break;
       }
+      good += off_t(9) + klen + vlen;
     }
     ::close(rfd);
+    if (end > good) ::truncate(path_.c_str(), good);
   }
   fd_ = ::open(path_.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
 }

@@ -24,6 +24,10 @@ enum class ChangeOp : uint8_t {
   Decr = 4,
   Append = 5,
   Prepend = 6,
+  // Staged so device-side Merkle mirrors see TRUNCATE/FLUSHDB, but never
+  // published: the reference replicates only the six ops above
+  // (replication.rs:197-254).
+  Truncate = 7,
 };
 
 struct ChangeRecord {

@@ -177,6 +177,7 @@ ParseResult parse_command(const std::string& line) {
     if (u == "MEMORY") { c.verb = Verb::Memory; return ok(std::move(c)); }
     if (u == "SCAN") { c.verb = Verb::Scan; return ok(std::move(c)); }
     if (u == "HASH") { c.verb = Verb::Hash; return ok(std::move(c)); }
+    if (u == "LEAFHASHES") { c.verb = Verb::LeafHashes; return ok(std::move(c)); }
     if (u == "CLIENT") { c.verb = Verb::ClientList; return ok(std::move(c)); }
     if (u == "PING") { c.verb = Verb::Ping; return ok(std::move(c)); }
     if (u == "SHUTDOWN") { c.verb = Verb::Shutdown; return ok(std::move(c)); }
@@ -325,6 +326,20 @@ ParseResult parse_command(const std::string& line) {
     if (auto e = bad_char(rest, "prefix")) return err(*e);
     Command c;
     c.verb = Verb::Scan;
+    c.prefix = rest;
+    return ok(std::move(c));
+  }
+  if (u == "LEAFHASHES") {
+    // Anti-entropy wire verb: per-key leaf digests so peers can diff
+    // without shipping values (the hash-walk the reference documents,
+    // README.md:310-372, but never implemented — sync.rs:150-214 ships
+    // full state).
+    if (rest.find(' ') != std::string::npos) {
+      return err("LEAFHASHES command accepts only one argument");
+    }
+    if (auto e = bad_char(rest, "prefix")) return err(*e);
+    Command c;
+    c.verb = Verb::LeafHashes;
     c.prefix = rest;
     return ok(std::move(c));
   }

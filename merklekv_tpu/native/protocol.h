@@ -19,7 +19,7 @@ namespace mkv {
 enum class Verb {
   Get, Set, Delete, Increment, Decrement, Append, Prepend,
   MultiGet, MultiSet, Truncate, Exists, Scan, Dbsize, Hash,
-  Stats, Info, Version, Memory, ClientList, Flushdb, Shutdown,
+  LeafHashes, Stats, Info, Version, Memory, ClientList, Flushdb, Shutdown,
   Ping, Echo, Sync, Replicate,
 };
 
@@ -33,7 +33,7 @@ struct Command {
   std::vector<std::string> keys;   // Exists/MultiGet
   std::vector<std::pair<std::string, std::string>> pairs;  // MultiSet
   std::string message;             // Ping/Echo
-  std::string prefix;              // Scan
+  std::string prefix;              // Scan / LeafHashes
   std::optional<std::string> pattern;  // Hash
   std::string host;                // Sync
   uint16_t port = 0;               // Sync

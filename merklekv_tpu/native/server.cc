@@ -137,6 +137,11 @@ void Server::accept_loop() {
       std::lock_guard lk(clients_mu_);
       clients_[meta->id] = meta;
     }
+    // stop() may have run between the stop_ check above and the
+    // registration: it would then have missed this fd when poking clients_,
+    // leaving the handler parked in recv() forever and wait() spinning.
+    // Re-check after registration so one side always sees the other.
+    if (stop_.load(std::memory_order_acquire)) ::shutdown(fd, SHUT_RDWR);
     stats_.total_connections++;
     stats_.active_connections++;
     live_handlers_.fetch_add(1, std::memory_order_acq_rel);
@@ -301,6 +306,21 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       // otherwise a plain prefix.
       std::string pat = cmd.pattern.value_or("");
       std::string prefix = (pat == "*") ? "" : pat;
+      if (pat.empty()) {
+        // Bare HASH only ("HASH *" echoes the pattern, a different wire
+        // shape): give the control plane first refusal — it serves from
+        // the device-resident incremental tree in O(1) after warm build
+        // instead of rehashing every leaf here.
+        ClusterCallback cb;
+        {
+          std::lock_guard lk(cb_mu_);
+          cb = cluster_cb_;
+        }
+        if (cb) {
+          std::string resp = cb("HASH");
+          if (!resp.empty()) return resp;
+        }
+      }
       auto keys = engine_->scan(prefix);
       std::vector<std::pair<std::string, std::string>> items;
       items.reserve(keys.size());
@@ -364,14 +384,33 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       }
       return "OK\r\n";
     }
+    case Verb::LeafHashes: {
+      auto keys = engine_->scan(cmd.prefix);
+      std::string out = "HASHES " + std::to_string(keys.size()) + "\r\n";
+      size_t listed = 0;
+      for (const auto& k : keys) {
+        auto v = engine_->get(k);
+        if (!v) continue;  // deleted between scan and get
+        uint8_t d[32];
+        leaf_hash(k, *v, d);
+        out += k + " " + digest_hex(d) + "\r\n";
+        ++listed;
+      }
+      if (listed != keys.size()) {
+        out = "HASHES " + std::to_string(listed) +
+              out.substr(out.find("\r\n"));
+      }
+      return out;
+    }
     case Verb::Truncate:
     case Verb::Flushdb: {
       // FLUSHDB truncates, like the reference (server.rs:901-908).
       if (!engine_->truncate()) return "ERROR truncate failed\r\n";
+      stage_event(ChangeOp::Truncate, "", "", false);
       return "OK\r\n";
     }
     case Verb::Stats:
-      return "STATS\r\n" + stats_.format_stats();
+      return "STATS\r\n" + stats_.format_stats() + "END\r\n";
     case Verb::Info: {
       std::string out = "INFO\r\n";
       out += "version:" + opts_.version + "\r\n";
@@ -380,6 +419,7 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       out += "uptime:" + stats_.uptime_human() + "\r\n";
       out += "server_time_unix:" + std::to_string(unix_now()) + "\r\n";
       out += "db_keys:" + std::to_string(engine_->dbsize()) + "\r\n";
+      out += "END\r\n";
       return out;
     }
     case Verb::Version:

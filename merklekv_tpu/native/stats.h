@@ -88,7 +88,8 @@ struct ServerStats {
       case Verb::ClientList: management_commands++; break;
       case Verb::Memory: memory_commands++; break;
       case Verb::Sync: sync_commands++; break;
-      case Verb::Hash: hash_commands++; break;
+      case Verb::Hash:
+      case Verb::LeafHashes: hash_commands++; break;
       case Verb::Replicate: replicate_commands++; break;
     }
   }

@@ -296,6 +296,7 @@ class ChangeEventRaw:
 
 
 OP_SET, OP_DEL, OP_INCR, OP_DECR, OP_APPEND, OP_PREPEND = 1, 2, 3, 4, 5, 6
+OP_TRUNCATE = 7  # staged for device-mirror invalidation, never replicated
 
 
 class NativeServer:

@@ -23,8 +23,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _spawn(args, **kw):
-    env = dict(os.environ, PYTHONPATH=REPO)
-    # Server processes don't need a TPU; keep jax out of their startup path.
+    # Server processes must not race for the single tunneled TPU; the device
+    # Merkle mirror inside each server runs jax-on-CPU instead.
+    env = dict(os.environ, PYTHONPATH=REPO, MERKLEKV_JAX_PLATFORM="cpu")
     return subprocess.Popen(
         [sys.executable, *args],
         stdout=subprocess.PIPE,

@@ -1,0 +1,177 @@
+"""Device-resident Merkle mirror behind the serving HASH path.
+
+Round-1 gap (VERDICT): the TPU incremental tree existed but nothing served
+from it — HASH recomputed a full CPU root per call. These tests pin:
+  - HASH parity between the device mirror and the native CPU path,
+  - incremental (not full-rebuild) absorption of value updates,
+  - truncate invalidation,
+  - remote LWW applies feeding the mirror.
+"""
+
+import time
+import uuid
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.cluster.mirror import DeviceTreeMirror
+from merklekv_tpu.cluster.node import ClusterNode
+from merklekv_tpu.cluster.transport import TcpBroker
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+
+@pytest.fixture
+def broker():
+    b = TcpBroker()
+    yield b
+    b.close()
+
+
+class Node:
+    def __init__(self, broker, topic, node_id):
+        self.engine = NativeEngine("mem")
+        self.server = NativeServer(self.engine, "127.0.0.1", 0)
+        self.server.start()
+        cfg = Config()
+        cfg.replication.enabled = True
+        cfg.replication.mqtt_broker = broker.host
+        cfg.replication.mqtt_port = broker.port
+        cfg.replication.topic_prefix = topic
+        cfg.replication.client_id = node_id
+        self.cluster = ClusterNode(cfg, self.engine, self.server)
+        self.cluster.start()
+        self.client = MerkleKVClient("127.0.0.1", self.server.port).connect()
+
+    def close(self):
+        self.client.close()
+        self.cluster.stop()
+        self.server.close()
+        self.engine.close()
+
+
+@pytest.fixture
+def node(broker):
+    n = Node(broker, f"mirror-{uuid.uuid4().hex[:8]}", "m1")
+    yield n
+    n.close()
+
+
+def _wait_ready(node, timeout=30.0):
+    node.client.hash()  # triggers warming
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if node.cluster._mirror is not None and node.cluster._mirror.ready():
+            return
+        time.sleep(0.02)
+    raise TimeoutError("mirror never warmed")
+
+
+def test_hash_served_from_device_matches_native(node):
+    for i in range(32):
+        node.client.set(f"mk{i:03d}", f"v{i}")
+    native_root = node.engine.merkle_root().hex()
+    assert node.client.hash() == native_root  # native path while cold
+    _wait_ready(node)
+    # Warm path must agree bit-exactly with the native CPU tree.
+    assert node.cluster.device_root_hex() == native_root
+    assert node.client.hash() == native_root
+
+
+def test_value_updates_are_incremental_after_warm(node):
+    for i in range(64):
+        node.client.set(f"ik{i:03d}", f"v{i}")
+    _wait_ready(node)
+    node.client.hash()  # force initial build
+    state = node.cluster._mirror.state
+    rebuilds_before = state.full_rebuilds
+    # Value updates of existing keys: incremental scatter path only.
+    for i in range(8):
+        node.client.set(f"ik{i:03d}", f"updated-{i}")
+    root = node.cluster.device_root_hex()
+    assert root == node.engine.merkle_root().hex()
+    assert state.full_rebuilds == rebuilds_before
+    assert state.incremental_batches >= 1
+
+
+def test_truncate_invalidates_mirror(node):
+    node.client.set("gone", "soon")
+    _wait_ready(node)
+    assert node.cluster.device_root_hex() != "0" * 64
+    node.client.flushdb()
+    assert node.cluster.device_root_hex() == "0" * 64
+    assert node.client.hash() == "0" * 64
+
+
+def test_remote_applies_feed_mirror(broker):
+    topic = f"mirror2-{uuid.uuid4().hex[:8]}"
+    n1 = Node(broker, topic, "r1")
+    n2 = Node(broker, topic, "r2")
+    try:
+        _wait_ready(n2)
+        n1.client.set("replicated", "value")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if n2.client.get("replicated") == "value":
+                break
+            time.sleep(0.02)
+        assert n2.client.get("replicated") == "value"
+        # n2's device root includes the remotely applied write.
+        assert n2.cluster.device_root_hex() == n2.engine.merkle_root().hex()
+    finally:
+        n1.close()
+        n2.close()
+
+
+def test_sync_repairs_feed_mirror(broker):
+    """Anti-entropy writes bypass the server event queue; the mirror must
+    still see them or HASH serves a stale root forever after a SYNC."""
+    topic = f"mirror3-{uuid.uuid4().hex[:8]}"
+    n1 = Node(broker, topic, "s1")
+    try:
+        # A plain peer outside the replication fabric, with extra data.
+        peer_eng = NativeEngine("mem")
+        peer_srv = NativeServer(peer_eng, "127.0.0.1", 0)
+        peer_srv.start()
+        try:
+            peer_eng.set(b"sync-only", b"via-anti-entropy")
+            n1.client.set("own", "write")
+            _wait_ready(n1)
+            assert n1.cluster.device_root_hex() == n1.engine.merkle_root().hex()
+            # SYNC pulls sync-only in through the engine bindings.
+            assert n1.client.sync_with("127.0.0.1", peer_srv.port)
+            assert n1.client.get("sync-only") == "via-anti-entropy"
+            # The warm mirror must reflect the repair immediately.
+            assert (
+                n1.cluster.device_root_hex()
+                == n1.engine.merkle_root().hex()
+            )
+        finally:
+            peer_srv.close()
+            peer_eng.close()
+    finally:
+        n1.close()
+
+
+def test_mirror_converges_despite_event_payload_staleness():
+    """on_events re-reads the engine, so replay order can't regress values."""
+    eng = NativeEngine("mem")
+    try:
+        eng.set(b"k", b"newest")
+        mirror = DeviceTreeMirror(eng)
+        mirror.start_warming()
+        deadline = time.time() + 30
+        while not mirror.ready() and time.time() < deadline:
+            time.sleep(0.02)
+        assert mirror.ready()
+        # A stale event for k arrives late: the mirror must end on the
+        # engine's current value, not the payload's.
+        from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind
+
+        mirror.on_events(
+            [ChangeEvent(op=OpKind.SET, key="k", val=b"old", ts=1, src="x")]
+        )
+        assert mirror.root_hex() == eng.merkle_root().hex()
+        mirror.close()
+    finally:
+        eng.close()

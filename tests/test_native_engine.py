@@ -134,6 +134,48 @@ def test_log_engine_persistence():
             assert e2.dbsize() == 2
 
 
+def test_log_engine_torn_tail_then_write_survives_second_restart():
+    # Regression: replay used to stop at a torn tail record without
+    # truncating the file; the log was then reopened O_APPEND, so writes
+    # made after recovery landed *behind* the corrupt bytes and the next
+    # replay silently dropped them.
+    import os
+
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.set(b"keep", b"1")
+            e.sync()
+        log = os.path.join(d, "data.log")
+        with open(log, "ab") as f:
+            f.write(b"\x01\xff\xff")  # torn record: op + partial klen
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"keep") == b"1"
+            e2.set(b"after-recovery", b"2")
+            e2.sync()
+        with NativeEngine("log", d) as e3:
+            assert e3.get(b"keep") == b"1"
+            assert e3.get(b"after-recovery") == b"2"
+
+
+def test_log_engine_corrupt_length_tail_truncated():
+    # A tail whose lengths are absurd (claimed > 64 MiB) must also be cut.
+    import os
+
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.set(b"a", b"1")
+            e.sync()
+        log = os.path.join(d, "data.log")
+        with open(log, "ab") as f:
+            f.write(b"\x01" + (0xFFFFFFFF).to_bytes(4, "little") * 2 + b"junk")
+        with NativeEngine("log", d) as e2:
+            e2.set(b"b", b"2")
+            e2.sync()
+        with NativeEngine("log", d) as e3:
+            assert e3.get(b"a") == b"1"
+            assert e3.get(b"b") == b"2"
+
+
 def test_log_engine_truncate_persists():
     with tempfile.TemporaryDirectory() as d:
         with NativeEngine("log", d) as e:

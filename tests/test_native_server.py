@@ -38,6 +38,32 @@ def client(server):
     c.close()
 
 
+def test_leafhashes_parity_and_prefix(client):
+    from merklekv_tpu.merkle.encoding import leaf_hash
+
+    assert client.leaf_hashes() == {}
+    client.mset({"a:1": "v1", "a:2": "v2", "b:1": "v3"})
+    hashes = client.leaf_hashes()
+    assert sorted(hashes) == ["a:1", "a:2", "b:1"]
+    for k, hx in hashes.items():
+        assert hx == leaf_hash(k.encode(), client.get(k).encode()).hex()
+    assert sorted(client.leaf_hashes("a:")) == ["a:1", "a:2"]
+    assert client.leaf_hashes("zz") == {}
+
+
+def test_leafhashes_rejects_extra_args(client):
+    with pytest.raises(ProtocolError, match="only one argument"):
+        client.leaf_hashes("a b")
+
+
+def test_stats_info_end_terminated(server):
+    out = raw(server, b"STATS\r\nINFO\r\n")[0].decode()
+    stats_block, info_block = out.split("INFO\r\n", 1)
+    assert stats_block.startswith("STATS\r\n")
+    assert stats_block.rstrip("\r\n").endswith("END")
+    assert info_block.rstrip("\r\n").endswith("END")
+
+
 def raw(server, *lines) -> list[bytes]:
     """Send raw lines on a fresh socket, return full response bytes."""
     s = socket.create_connection(("127.0.0.1", server.port), timeout=5)

@@ -112,6 +112,111 @@ def test_sync_command_over_protocol(two_nodes):
         node.stop()
 
 
+def test_hash_first_fetches_only_divergent_values(two_nodes):
+    """The core fix over the reference: bandwidth ∝ divergence, not keyspace.
+
+    Reference sync ships the entire remote keyspace as values whenever roots
+    differ (/root/reference/src/sync.rs:150-214). Here 1% divergence must
+    fetch ~1% of values.
+    """
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    items = {f"hf{i:05d}": f"v{i}" for i in range(1000)}
+    fill(remote_eng, items)
+    fill(local_eng, items)
+    # Diverge 10 of 1000 keys (1%): 5 stale, 3 local-only, 2 missing locally.
+    for i in range(5):
+        local_eng.set(f"hf{i:05d}".encode(), b"stale")
+    for i in range(3):
+        local_eng.set(f"local-only-{i}".encode(), b"x")
+    for i in range(5, 7):
+        local_eng.delete(f"hf{i:05d}".encode())
+
+    mgr = SyncManager(local_eng, device="cpu")
+    report = mgr.sync_once("127.0.0.1", remote_srv.port)
+
+    assert report.mode == "hash-first"
+    assert report.divergent == 10
+    assert report.values_fetched == 7  # ONLY divergent remote keys travel
+    assert report.set_keys == 7 and report.deleted_keys == 3
+    assert local_eng.snapshot() == remote_eng.snapshot()
+    assert local_eng.merkle_root() == remote_eng.merkle_root()
+
+
+def test_full_flag_forces_snapshot_transfer(two_nodes):
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    items = {f"ff{i}": f"v{i}" for i in range(100)}
+    fill(remote_eng, items)
+    local_eng.set(b"ff0", b"stale")
+    fill(local_eng, {k: v for k, v in items.items() if k != "ff0"})
+
+    report = SyncManager(local_eng, device="cpu").sync_once(
+        "127.0.0.1", remote_srv.port, full=True
+    )
+    assert report.mode == "full"
+    assert report.values_fetched == 100  # whole keyspace travelled
+    assert report.divergent == 1
+    assert local_eng.snapshot() == remote_eng.snapshot()
+
+
+def test_verify_flag_rechecks_roots(two_nodes):
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    fill(remote_eng, {"vk": "v"})
+    report = SyncManager(local_eng, device="cpu").sync_once(
+        "127.0.0.1", remote_srv.port, verify=True
+    )
+    assert report.verified is True
+    # noop path reports verified too
+    report = SyncManager(local_eng, device="cpu").sync_once(
+        "127.0.0.1", remote_srv.port, verify=True
+    )
+    assert report.mode == "noop" and report.verified is True
+
+
+def test_verify_failure_raises(two_nodes):
+    """A repair that does not converge must surface through --verify."""
+
+    class DroppingEngine:
+        """Engine proxy whose writes vanish — sync can't actually repair."""
+
+        def __init__(self, eng):
+            self._eng = eng
+
+        def __getattr__(self, name):
+            return getattr(self._eng, name)
+
+        def set(self, k, v):
+            return True  # dropped
+
+        def delete(self, k):
+            return False
+
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    fill(remote_eng, {"only-remote": "v"})
+    mgr = SyncManager(DroppingEngine(local_eng), device="cpu")
+    with pytest.raises(RuntimeError, match="verify failed"):
+        mgr.sync_once("127.0.0.1", remote_srv.port, verify=True)
+    assert mgr.last_report.verified is False
+
+
+def test_sync_flags_over_protocol(two_nodes):
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.config import Config
+
+    (local_eng, local_srv), (remote_eng, remote_srv) = two_nodes
+    fill(remote_eng, {"flagged": "yes"})
+    node = ClusterNode(Config(), local_eng, local_srv)
+    node.start()
+    try:
+        with MerkleKVClient("127.0.0.1", local_srv.port) as c:
+            assert c.sync_with("127.0.0.1", remote_srv.port, full=True,
+                               verify=True)
+            assert c.get("flagged") == "yes"
+            assert node.sync_manager.last_report.mode == "full"
+            assert node.sync_manager.last_report.verified is True
+    finally:
+        node.stop()
+
+
 def test_periodic_loop_repairs(two_nodes):
     (local_eng, _), (remote_eng, remote_srv) = two_nodes
     fill(remote_eng, {"auto": "repaired"})
