@@ -1,14 +1,21 @@
-"""Device-resident Merkle state with incremental O(k log C) updates.
+"""Device-resident Merkle state with incremental updates for every op kind.
 
 The reference rebuilds its whole tree on every mutation
 (/root/reference/src/store/merkle.rs:52-56) and never updates the tree from
 replication events (TODO at replication.rs:312-316). Here the tree LIVES in
-device HBM and change-event batches are applied as one XLA program:
+device HBM and change-event batches are applied as XLA programs:
 
-  1. hash the k changed leaves (batched SHA-256),
-  2. scatter them into the capacity-padded leaf level,
-  3. re-reduce only the touched parent paths — k node hashes per level,
-     log2(C) levels.
+- **value updates** (keyspace shape unchanged): hash the k changed leaves,
+  scatter them into the capacity-padded leaf level, re-reduce only the
+  touched parent paths — O(k log C) device work.
+- **inserts / deletes** (shape changes): the sorted layout shifts, so the
+  interior of the tree right of the first edit must re-reduce — but the
+  surviving leaves' digests are already on device. The batch becomes: host
+  computes the permutation (numpy index arithmetic, no hashing), device
+  gathers surviving digests into their new slots, scatters the k fresh
+  digests, and re-reduces all levels. Host hashing cost is O(k changed
+  leaves), never O(n); the O(n) interior re-reduction is pure 64-byte
+  SHA-256 compressions in one fused program.
 
 Representation: a FULL binary tree at capacity C = 2^d (slots >= n hold a
 zero sentinel). The reference tree pairs only live nodes and promotes odd
@@ -18,17 +25,14 @@ position except the last. ``_ref_root`` therefore recovers the bit-exact
 reference root in one O(log C) walk that carries the corrected last node
 ("promotion chain") and reads one padded node per level.
 
-Sorted-order maintenance is host-side: value updates keep positions stable
-(O(k log C) device work); key inserts/deletes shift the dense sorted layout,
-so they mark the state dirty and the next root triggers a full batched
-rebuild — which the Pallas path does at ~10^7+ leaves/s, so the rebuild
-amortizes across any realistic insert rate.
+Host memory: only the sorted key array is kept (values are never stored —
+fresh digests are computed from the (key, value) pairs each batch carries),
+so a 10M-key tree costs the host one object array, not a value map.
 """
 
 from __future__ import annotations
 
-from bisect import bisect_left, insort
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import Iterable, Optional, Sequence
 
 import numpy as np
@@ -37,7 +41,6 @@ import jax
 import jax.numpy as jnp
 
 from merklekv_tpu.merkle.jax_engine import leaf_digests
-from merklekv_tpu.merkle.packing import pack_leaves
 from merklekv_tpu.ops.sha256 import digest_to_bytes, sha256_node_pairs
 
 __all__ = ["DeviceMerkleState"]
@@ -50,6 +53,29 @@ def _next_pow2(n: int) -> int:
 def _bucket(k: int) -> int:
     """Round a batch size up so one compiled program serves many sizes."""
     return _next_pow2(max(k, 16))
+
+
+def _reduce_levels(leaves: jax.Array) -> tuple:
+    """All padded-tree levels bottom-up; trace-time loop, static shapes."""
+    levels = [leaves]
+    cur = leaves
+    while cur.shape[0] > 1:
+        cur = sha256_node_pairs(cur[0::2], cur[1::2])
+        levels.append(cur)
+    return tuple(levels)
+
+
+@lru_cache(maxsize=None)
+def _build_fn(capacity: int):
+    """Compiled initial build over capacity-padded leaves: one compile per
+    capacity bucket, shared by every live count within it (the caller pads
+    the digest array to C on the host)."""
+
+    @jax.jit
+    def go(leaves: jax.Array):
+        return _reduce_levels(leaves)
+
+    return go
 
 
 @lru_cache(maxsize=None)
@@ -70,6 +96,27 @@ def _scatter_update_fn(capacity: int, kb: int):
             parents = sha256_node_pairs(left, right)
             out.append(levels[lvl].at[cur_idx].set(parents))
         return tuple(out)
+
+    return go
+
+
+@lru_cache(maxsize=None)
+def _restructure_fn(c_old: int, c_new: int, kb: int):
+    """Compiled gather + scatter + full reduction for shape changes.
+
+    gather_idx [c_new] int32: source slot in the OLD leaf level for each new
+    slot, or -1 for slots that receive a fresh digest / stay zero.
+    fresh_pos [kb] int32 + fresh [kb, 8]: the k changed/inserted digests
+    (padded entries duplicate entry 0 — same value, benign).
+    """
+
+    @jax.jit
+    def go(old_leaves, gather_idx, fresh_pos, fresh):
+        safe = jnp.clip(gather_idx, 0, max(c_old - 1, 0))
+        base = jnp.where((gather_idx >= 0)[:, None], old_leaves[safe], 0)
+        if kb:
+            base = base.at[fresh_pos].set(fresh)
+        return _reduce_levels(base)
 
     return go
 
@@ -106,69 +153,105 @@ def _ref_root_fn(capacity: int):
 class DeviceMerkleState:
     """Sorted keyspace + device-resident padded tree levels.
 
-    Host side owns the sorted key list and (key -> value bytes) map (the
-    authoritative store is the native engine; this mirrors only what the
-    tree needs). Device side owns ``levels``: levels[0] is [C, 8] leaf
-    digests, levels[d] is [1, 8].
+    Host side owns only the sorted key array (the authoritative KV store is
+    the native engine). Device side owns ``levels``: levels[0] is [C, 8]
+    leaf digests, levels[d] is [1, 8].
     """
 
+    # Auto-flush ceiling: bounds the host memory pending values can hold.
+    PENDING_LIMIT = 65536
+
     def __init__(self) -> None:
-        self._keys: list[bytes] = []
-        self._pos: dict[bytes, int] = {}
-        self._values: dict[bytes, bytes] = {}
+        self._keys = np.empty(0, dtype=object)  # sorted key bytes
         self._levels: Optional[tuple[jax.Array, ...]] = None
         self._capacity = 0
-        self._dirty = True  # structure changed; next root does a full build
+        # Writes accumulate here and flush as ONE device batch at the next
+        # query (or at PENDING_LIMIT): a stream of N single-key applies
+        # costs one restructure, not N — the amortization a per-write
+        # caller (the mirror's remote-apply path) depends on.
+        self._pending: dict[bytes, Optional[bytes]] = {}
         self.full_rebuilds = 0
         self.incremental_batches = 0
+        self.structural_batches = 0
 
     # ------------------------------------------------------------ loading
     @classmethod
-    def from_items(cls, items: Iterable[tuple[bytes, bytes]]) -> "DeviceMerkleState":
+    def from_items(
+        cls, items: Iterable[tuple[bytes, bytes]]
+    ) -> "DeviceMerkleState":
         st = cls()
-        for k, v in items:
-            st._values[k] = v
-        st._keys = sorted(st._values)
-        st._pos = {k: i for i, k in enumerate(st._keys)}
-        st._dirty = True
+        dedup = dict(items)
+        if dedup:
+            ordered = sorted(dedup.items())
+            st._initial_build(
+                np.array([k for k, _ in ordered], dtype=object),
+                [v for _, v in ordered],
+            )
         return st
 
     def __len__(self) -> int:
+        self._flush()
         return len(self._keys)
+
+    # ------------------------------------------------------------ lookups
+    def _find(self, key: bytes) -> int:
+        """Position of key in the sorted array, or -1."""
+        i = int(np.searchsorted(self._keys, np.array(key, dtype=object)))
+        if i < len(self._keys) and self._keys[i] == key:
+            return i
+        return -1
+
+    def _positions(self, keys: Sequence[bytes]) -> np.ndarray:
+        """Sorted-array positions for keys known to be present."""
+        if not len(self._keys):
+            return np.empty(0, np.int32)
+        arr = np.array(list(keys), dtype=object)
+        return np.searchsorted(self._keys, arr).astype(np.int32)
 
     # ------------------------------------------------------------ updates
     def apply(self, changes: Sequence[tuple[bytes, Optional[bytes]]]) -> None:
-        """Apply (key, value|None-for-delete) changes.
-
-        Value updates of existing keys go through the incremental device
-        path; inserts and deletes change the sorted layout and mark the
-        state for a full rebuild at the next root query.
-        """
-        in_place: dict[bytes, bytes] = {}
+        """Stage (key, value|None-for-delete) changes; last write per key
+        wins. Device work is deferred to the next query so bursts of
+        single-key applies amortize into one batch."""
         for k, v in changes:
-            if v is None:
-                if k in self._values:
-                    del self._values[k]
-                    self._dirty = True
-                    in_place.pop(k, None)
-            elif k in self._values:
-                self._values[k] = v
-                in_place[k] = v
-            else:
-                self._values[k] = v
-                self._dirty = True
-        if self._dirty:
-            # Layout shifted; incremental positions are meaningless.
-            return
-        if in_place and self._levels is not None:
-            self._incremental_update(sorted(in_place.items()))
+            self._pending[k] = v
+        if len(self._pending) >= self.PENDING_LIMIT:
+            self._flush()
 
-    def _incremental_update(self, items: list[tuple[bytes, bytes]]) -> None:
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, {}
+
+        # One vectorized membership pass classifies the whole batch.
+        keys = np.array(sorted(pending), dtype=object)
+        if len(self._keys):
+            pos = np.searchsorted(self._keys, keys)
+            clipped = np.clip(pos, 0, len(self._keys) - 1)
+            present = self._keys[clipped] == keys
+        else:
+            present = np.zeros(len(keys), bool)
+
+        deletes = [
+            k for k, p in zip(keys, present) if p and pending[k] is None
+        ]
+        inserts = [
+            k for k, p in zip(keys, present) if not p and pending[k] is not None
+        ]
+        upserts = {k: v for k, v in pending.items() if v is not None}
+
+        if not deletes and not inserts:
+            updates = sorted(upserts.items())
+            if updates and self._levels is not None:
+                self._update_in_place(updates)
+            return
+        self._restructure(deletes, upserts, inserts)
+
+    def _update_in_place(self, items: list[tuple[bytes, bytes]]) -> None:
         k = len(items)
         kb = _bucket(k)
         idx = np.empty(kb, np.int32)
-        for i, (key, _) in enumerate(items):
-            idx[i] = self._pos[key]
+        idx[:k] = self._positions([key for key, _ in items])
         idx[k:] = idx[0]  # pad with a duplicate of a real entry
         digests = leaf_digests([key for key, _ in items],
                                [v for _, v in items])
@@ -179,34 +262,93 @@ class DeviceMerkleState:
         self._levels = fn(self._levels, jnp.asarray(idx), new_leaves)
         self.incremental_batches += 1
 
-    # ------------------------------------------------------------ rebuild
-    def _full_rebuild(self) -> None:
-        self._keys = sorted(self._values)
-        self._pos = {k: i for i, k in enumerate(self._keys)}
-        n = len(self._keys)
-        if n == 0:
+    # ------------------------------------------------------------ structure
+    def _initial_build(self, keys_arr: np.ndarray, values: list) -> None:
+        n = len(keys_arr)
+        c = _next_pow2(n)
+        digests = np.asarray(leaf_digests(list(keys_arr), values))
+        padded = np.zeros((c, 8), np.uint32)
+        padded[:n] = digests
+        self._levels = _build_fn(c)(jnp.asarray(padded))
+        self._keys = keys_arr
+        self._capacity = c
+        self.full_rebuilds += 1
+
+    def _restructure(
+        self,
+        deletes: list[bytes],
+        upserts: dict[bytes, Optional[bytes]],
+        inserts: list[bytes],
+    ) -> None:
+        old = self._keys
+        n_old = len(old)
+
+        # Host plan: pure index arithmetic, no hashing of survivors.
+        del_pos = self._positions(deletes)
+        survivors = np.delete(old, del_pos) if len(del_pos) else old
+        surv_src = (
+            np.delete(np.arange(n_old, dtype=np.int32), del_pos)
+            if len(del_pos)
+            else np.arange(n_old, dtype=np.int32)
+        )
+        ins_keys = np.array(sorted(inserts), dtype=object)
+        if len(ins_keys):
+            ins_at = np.searchsorted(survivors, ins_keys).astype(np.int64)
+            new_keys = np.insert(survivors, ins_at, ins_keys)
+            gather = np.insert(surv_src, ins_at, np.int32(-1))
+        else:
+            new_keys = survivors
+            gather = surv_src
+        n_new = len(new_keys)
+        if n_new == 0:
+            self._keys = np.empty(0, dtype=object)
             self._levels = None
             self._capacity = 0
-            self._dirty = False
             return
-        c = _next_pow2(n)
-        digests = leaf_digests(self._keys, [self._values[k] for k in self._keys])
-        leaves = jnp.zeros((c, 8), jnp.uint32).at[:n].set(digests)
-        levels = [leaves]
-        cur = leaves
-        while cur.shape[0] > 1:
-            cur = sha256_node_pairs(cur[0::2], cur[1::2])
-            levels.append(cur)
-        self._levels = tuple(levels)
-        self._capacity = c
-        self._dirty = False
-        self.full_rebuilds += 1
+        if self._levels is None:
+            # Empty -> non-empty: everything is fresh; all values are in
+            # this batch by construction.
+            self._initial_build(
+                new_keys, [upserts[k] for k in new_keys]
+            )
+            return
+
+        c_new = _next_pow2(n_new)
+        gather_padded = np.full(c_new, -1, np.int32)
+        gather_padded[:n_new] = gather
+
+        # Fresh digests: every upsert (update of a survivor or insert).
+        fresh_items = sorted(upserts.items())
+        k = len(fresh_items)
+        kb = _bucket(k) if k else 0
+        if k:
+            fresh_keys = np.array([key for key, _ in fresh_items],
+                                  dtype=object)
+            fresh_pos = np.empty(kb, np.int32)
+            fresh_pos[:k] = np.searchsorted(new_keys, fresh_keys)
+            fresh_pos[k:] = fresh_pos[0]
+            digests = leaf_digests([key for key, _ in fresh_items],
+                                   [v for _, v in fresh_items])
+            fresh = jnp.concatenate(
+                [digests, jnp.broadcast_to(digests[0], (kb - k, 8))], axis=0
+            ) if kb > k else digests
+        else:
+            fresh_pos = np.zeros(0, np.int32)
+            fresh = jnp.zeros((0, 8), jnp.uint32)
+
+        fn = _restructure_fn(self._capacity, c_new, kb)
+        self._levels = fn(
+            self._levels[0], jnp.asarray(gather_padded),
+            jnp.asarray(fresh_pos), fresh,
+        )
+        self._keys = new_keys
+        self._capacity = c_new
+        self.structural_batches += 1
 
     # ------------------------------------------------------------ queries
     def root_hash(self) -> Optional[bytes]:
-        if self._dirty:
-            self._full_rebuild()
-        if not self._keys:
+        self._flush()
+        if not len(self._keys) or self._levels is None:
             return None
         root = _ref_root_fn(self._capacity)(
             self._levels, jnp.int32(len(self._keys))
@@ -218,9 +360,8 @@ class DeviceMerkleState:
         return r.hex() if r is not None else "0" * 64
 
     def leaf_digest(self, key: bytes) -> Optional[bytes]:
-        if self._dirty:
-            self._full_rebuild()
-        i = self._pos.get(key)
-        if i is None or self._levels is None:
+        self._flush()
+        i = self._find(key)
+        if i < 0 or self._levels is None:
             return None
         return digest_to_bytes(np.asarray(self._levels[0][i]))

@@ -56,24 +56,28 @@ def test_single_key_update():
     assert st.full_rebuilds == 1
 
 
-def test_insert_triggers_rebuild():
+def test_insert_is_structural_not_full_rebuild():
     items = {b"a": b"1", b"b": b"2"}
     st = DeviceMerkleState.from_items(items.items())
     st.root_hash()
     items[b"aa"] = b"between"  # shifts sorted positions
     st.apply([(b"aa", b"between")])
     assert st.root_hash() == cpu_root(items)
-    assert st.full_rebuilds == 2
+    # Survivor digests were gathered on device — no host re-hash of the
+    # whole keyspace.
+    assert st.full_rebuilds == 1
+    assert st.structural_batches == 1
 
 
-def test_delete_triggers_rebuild():
+def test_delete_is_structural_not_full_rebuild():
     items = {b"a": b"1", b"b": b"2", b"c": b"3"}
     st = DeviceMerkleState.from_items(items.items())
     st.root_hash()
     del items[b"b"]
     st.apply([(b"b", None)])
     assert st.root_hash() == cpu_root(items)
-    assert st.full_rebuilds == 2
+    assert st.full_rebuilds == 1
+    assert st.structural_batches == 1
 
 
 def test_mixed_batch_update_then_insert():
@@ -106,6 +110,87 @@ def test_capacity_padding_at_non_pow2_counts():
         items[b"pk%04d" % (n // 2)] = b"mut"
         st.apply([(b"pk%04d" % (n // 2), b"mut")])
         assert st.root_hash() == cpu_root(items), n
+
+
+def test_structural_fuzz_matches_cpu():
+    """Random mixed batches (insert/update/delete) against the golden tree.
+
+    This is the honesty check for the gather-restructure path: after every
+    batch the device root must equal the CPU reference root of the evolved
+    keyspace, across capacity growth and shrink."""
+    rng = np.random.RandomState(11)
+    items = {b"fz%04d" % i: b"v%d" % i for i in range(40)}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    universe = [b"fz%04d" % i for i in range(80)]
+    for round_ in range(12):
+        batch = []
+        for _ in range(rng.randint(1, 9)):
+            k = universe[rng.randint(len(universe))]
+            if rng.rand() < 0.3 and k in items:
+                del items[k]
+                batch.append((k, None))
+            else:
+                v = b"r%d-%d" % (round_, rng.randint(1000))
+                items[k] = v
+                batch.append((k, v))
+        st.apply(batch)
+        assert st.root_hash() == cpu_root(items), f"round {round_}"
+        assert len(st) == len(items)
+    assert st.full_rebuilds == 1  # never re-hashed the surviving keyspace
+
+
+def test_delete_all_then_refill():
+    items = {b"da%02d" % i: b"v" for i in range(5)}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    st.apply([(k, None) for k in items])
+    assert st.root_hash() is None
+    assert st.root_hex() == "0" * 64
+    st.apply([(b"fresh", b"start")])
+    assert st.root_hash() == cpu_root({b"fresh": b"start"})
+
+
+def test_capacity_growth_and_shrink():
+    items = {b"cg%03d" % i: b"v%d" % i for i in range(30)}
+    st = DeviceMerkleState.from_items(items.items())  # capacity 32
+    st.root_hash()
+    adds = {b"cg9%02d" % i: b"n%d" % i for i in range(10)}  # -> capacity 64
+    items.update(adds)
+    st.apply(list(adds.items()))
+    assert st.root_hash() == cpu_root(items)
+    drops = list(items)[:35]  # -> 5 keys, capacity shrinks
+    for k in drops:
+        del items[k]
+    st.apply([(k, None) for k in drops])
+    assert st.root_hash() == cpu_root(items)
+    assert st.full_rebuilds == 1
+
+
+def test_batch_coalesces_same_key():
+    items = {b"a": b"1"}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    # Same key written twice then deleted within one batch: last wins.
+    st.apply([(b"b", b"x"), (b"b", b"y"), (b"a", None), (b"a", b"back")])
+    assert st.root_hash() == cpu_root({b"a": b"back", b"b": b"y"})
+
+
+def test_single_key_applies_amortize_into_one_batch():
+    """A stream of per-write apply() calls (the mirror's remote-apply shape)
+    must coalesce into ONE device batch at the next root query — per-write
+    O(n) restructures would collapse remote-apply throughput."""
+    items = {b"am%03d" % i: b"v" for i in range(50)}
+    st = DeviceMerkleState.from_items(items.items())
+    st.root_hash()
+    for i in range(30):  # 30 separate single-key inserts
+        k = b"zz%03d" % i
+        items[k] = b"n"
+        st.apply([(k, b"n")])
+    assert st.structural_batches == 0  # nothing flushed yet
+    assert st.root_hash() == cpu_root(items)
+    assert st.structural_batches == 1  # all 30 in one batch
+    assert st.full_rebuilds == 1
 
 
 def test_leaf_digest_view():
