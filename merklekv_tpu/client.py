@@ -239,12 +239,22 @@ class MerkleKVClient:
 
     def leaf_hashes(self, prefix: str = "") -> dict[str, str]:
         """Per-key leaf digests (hex) — the anti-entropy narrowing fetch."""
+        return {k: h for k, (h, _) in self.leaf_hashes_ts(prefix).items()}
+
+    def leaf_hashes_ts(self, prefix: str = "") -> dict[str, tuple[str, int]]:
+        """Per-key (leaf digest hex, last-write unix-ns ts). Servers that
+        predate the ts field yield ts 0 ("unknown age")."""
         cmd = f"LEAFHASHES {prefix}" if prefix else "LEAFHASHES"
         n = _count_after(self._request(cmd), "HASHES ")
-        out: dict[str, str] = {}
+        out: dict[str, tuple[str, int]] = {}
         for _ in range(n):
-            k, _, h = self._read_line().rpartition(" ")
-            out[k] = h
+            parts = self._read_line().split(" ")
+            # Keys cannot contain spaces (protocol rule), so lines are
+            # either "key hex" (legacy) or "key hex ts".
+            if len(parts) >= 3:
+                out[parts[0]] = (parts[1], int(parts[2]))
+            else:
+                out[parts[0]] = (parts[1], 0)
         return out
 
     # -- admin ---------------------------------------------------------------

@@ -31,8 +31,13 @@ class LWWApplier:
         set_fn: Callable[[bytes, bytes], None],
         del_fn: Callable[[bytes], None],
         max_seen: int = 1 << 20,
+        set_ts_fn: Optional[Callable[[bytes, bytes, int], None]] = None,
     ) -> None:
         self._set = set_fn
+        # When the store tracks per-key last-write timestamps, applies go
+        # through set_ts_fn with the EVENT's ts so anti-entropy LWW and
+        # replication LWW agree on ordering.
+        self._set_ts = set_ts_fn
         self._del = del_fn
         self._seen: OrderedDict[bytes, None] = OrderedDict()
         self._max_seen = max_seen
@@ -63,7 +68,10 @@ class LWWApplier:
         elif ev.val is not None:
             # Post-op value semantics: INCR/DECR/APPEND/PREPEND all apply as
             # an absolute SET of the result (change_event.rs:17-19).
-            self._set(key, ev.val)
+            if self._set_ts is not None:
+                self._set_ts(key, ev.val, ev.ts)
+            else:
+                self._set(key, ev.val)
         self._last_ts[ev.key] = ev.ts
         self._last_op_id[ev.key] = ev.op_id
         self._remember(ev.op_id)

@@ -182,21 +182,12 @@ class DeviceTreeMirror:
     # -- internals -----------------------------------------------------------
     @staticmethod
     def _device_state_cls():
-        # MERKLEKV_JAX_PLATFORM lets multi-process harnesses pin server
-        # processes to jax-on-CPU: the environment's sitecustomize pins jax
-        # to the tunneled TPU, which is single-process — N spawned servers
-        # must not race for it. Must run before any jax backend initializes,
-        # hence here on the first device use, not at module import.
-        import os
+        # Honor MERKLEKV_JAX_PLATFORM before the first device use (not at
+        # module import): N spawned servers must not race for a
+        # single-process accelerator backend.
+        from merklekv_tpu.utils.jaxenv import ensure_platform
 
-        plat = os.environ.get("MERKLEKV_JAX_PLATFORM")
-        if plat:
-            import jax
-
-            try:
-                jax.config.update("jax_platforms", plat)
-            except RuntimeError:
-                pass  # backend already initialized; keep whatever it is
+        ensure_platform()
         from merklekv_tpu.merkle.incremental import DeviceMerkleState
 
         return DeviceMerkleState

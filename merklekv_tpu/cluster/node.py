@@ -55,6 +55,7 @@ class ClusterNode:
             self.sync_manager.start_loop(
                 self._cfg.anti_entropy.peers,
                 self._cfg.anti_entropy.interval_seconds,
+                multi_peer=self._cfg.anti_entropy.multi_peer,
             )
 
     def stop(self) -> None:

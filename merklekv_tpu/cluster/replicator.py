@@ -76,20 +76,22 @@ class Replicator:
         self._drain_interval = drain_interval
         self._batch_listener = batch_listener
         self._mirror = mirror
-        if mirror is None:
-            self._applier = LWWApplier(engine.set, lambda k: engine.delete(k))
-        else:
-            # Remote applies bypass the server's event queue (no echo loop),
-            # so the device mirror must be fed inline here.
-            def _set(k: bytes, v: bytes) -> None:
-                engine.set(k, v)
+
+        # Remote applies install the EVENT's timestamp (set_with_ts), so
+        # replication LWW and anti-entropy LWW share one ordering; they also
+        # bypass the server's event queue (no echo loop), so the device
+        # mirror must be fed inline here.
+        def _set_ts(k: bytes, v: bytes, ts: int) -> None:
+            engine.set_with_ts(k, v, ts)
+            if mirror is not None:
                 mirror.apply_one(k, v)
 
-            def _del(k: bytes) -> None:
-                engine.delete(k)
+        def _del(k: bytes) -> None:
+            engine.delete(k)
+            if mirror is not None:
                 mirror.apply_one(k, None)
 
-            self._applier = LWWApplier(_set, _del)
+        self._applier = LWWApplier(engine.set, _del, set_ts_fn=_set_ts)
         self._applier_mu = threading.Lock()
         # Spans drain..mirror-apply: a flush() must not return while another
         # thread holds drained-but-unapplied events, or device_root_hex's

@@ -42,10 +42,23 @@ from merklekv_tpu.merkle.encoding import leaf_hash
 from merklekv_tpu.native_bindings import NativeEngine
 from merklekv_tpu.utils.tracing import get_metrics, span
 
-__all__ = ["SyncManager", "SyncReport"]
+__all__ = ["SyncManager", "SyncReport", "MultiSyncReport"]
 
 # Below this many union keys the device round-trip costs more than hashlib.
 _DEVICE_THRESHOLD = 4096
+
+
+@dataclass
+class MultiSyncReport:
+    peers: list[str] = field(default_factory=list)
+    union_keys: int = 0
+    divergent_union: int = 0  # keys where ANY replica disagrees
+    # peer -> divergence count vs local; unreachable peers are absent.
+    per_peer_divergent: dict[str, int] = field(default_factory=dict)
+    set_keys: int = 0
+    values_fetched: int = 0
+    seconds: float = 0.0
+    details: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -64,6 +77,9 @@ class SyncReport:
 
 
 def _leaf_map_device(items: list[tuple[bytes, bytes]]) -> dict[bytes, bytes]:
+    from merklekv_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform()
     from merklekv_tpu.merkle.jax_engine import leaf_digests
     from merklekv_tpu.ops.sha256 import digests_to_bytes
 
@@ -77,6 +93,16 @@ def _leaf_map(items: list[tuple[bytes, bytes]], use_device: bool) -> dict[bytes,
     if use_device:
         return _leaf_map_device(items)
     return {k: leaf_hash(k, v) for k, v in items}
+
+
+def _decode_leaf_map(
+    raw: dict[str, tuple[str, int]]
+) -> dict[bytes, tuple[bytes, int]]:
+    """LEAFHASHES wire payload -> {key bytes: (digest bytes, unix-ns ts)}."""
+    return {
+        k.encode("utf-8", "surrogateescape"): (bytes.fromhex(h), ts)
+        for k, (h, ts) in raw.items()
+    }
 
 
 class SyncManager:
@@ -99,6 +125,7 @@ class SyncManager:
         self._loop_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_report: Optional[SyncReport] = None
+        self.last_multi_report: Optional[MultiSyncReport] = None
 
     # -- one-shot ------------------------------------------------------------
     def sync_once(
@@ -176,23 +203,21 @@ class SyncManager:
     # -- hash-first path ------------------------------------------------------
     def _fetch_remote_hashes(
         self, client: MerkleKVClient, report: SyncReport
-    ) -> Optional[dict[bytes, bytes]]:
-        """Peer leaf digests, or None if the peer can't serve LEAFHASHES."""
+    ) -> Optional[dict[bytes, tuple[bytes, int]]]:
+        """Peer (leaf digest, last-write ts) map, or None if the peer can't
+        serve LEAFHASHES."""
         try:
-            raw = client.leaf_hashes()
+            raw = client.leaf_hashes_ts()
         except Exception as e:
             report.details.append(f"LEAFHASHES unsupported: {e!r}")
             get_metrics().inc("anti_entropy.leafhash_fallbacks")
             return None
-        return {
-            k.encode("utf-8", "surrogateescape"): bytes.fromhex(h)
-            for k, h in raw.items()
-        }
+        return _decode_leaf_map(raw)
 
     def _sync_hash_first(
         self,
         client: MerkleKVClient,
-        remote_hashes: dict[bytes, bytes],
+        remote_hashes: dict[bytes, tuple[bytes, int]],
         report: SyncReport,
     ) -> None:
         local = {k: v for k, v in self._engine.snapshot()}
@@ -201,7 +226,8 @@ class SyncManager:
 
         use_device = self._use_device(len(set(local) | set(remote_hashes)))
         local_hashes = _leaf_map(sorted(local.items()), use_device)
-        divergent = self._diff(local_hashes, remote_hashes, use_device)
+        remote_digests = {k: d for k, (d, _) in remote_hashes.items()}
+        divergent = self._diff(local_hashes, remote_digests, use_device)
         report.divergent = len(divergent)
 
         to_fetch = [k for k in divergent if k in remote_hashes]
@@ -210,7 +236,9 @@ class SyncManager:
         for k in divergent:
             if k in remote_hashes:
                 if k in values:
-                    self._repair_set(k, values[k])
+                    # Propagate the peer's last-write ts with the value so
+                    # LWW ordering metadata survives the repair.
+                    self._repair_set(k, values[k], remote_hashes[k][1])
                     report.set_keys += 1
                 # else: deleted on the peer between LEAFHASHES and MGET;
                 # the next cycle repairs it.
@@ -240,8 +268,11 @@ class SyncManager:
                 self._repair_delete(k)
                 report.deleted_keys += 1
 
-    def _repair_set(self, k: bytes, v: bytes) -> None:
-        self._engine.set(k, v)
+    def _repair_set(self, k: bytes, v: bytes, ts: Optional[int] = None) -> None:
+        if ts is None:
+            self._engine.set(k, v)
+        else:
+            self._engine.set_with_ts(k, v, ts)
         if self._repair_listener is not None:
             self._repair_listener(k, v)
 
@@ -249,6 +280,160 @@ class SyncManager:
         self._engine.delete(k)
         if self._repair_listener is not None:
             self._repair_listener(k, None)
+
+    # -- multi-peer cycle -----------------------------------------------------
+    def sync_multi(self, peers: list[str]) -> MultiSyncReport:
+        """One anti-entropy cycle against ALL peers at once.
+
+        Gathers every peer's (leaf hash, last-write ts) pairs, stacks the
+        digests with the local map into one ``[R, N]`` divergence program
+        (merkle/diff.py), then arbitrates each divergent key by **per-key
+        LWW**: newest last-write timestamp wins; equal timestamps break
+        toward the lexicographically larger digest (deterministic). Only
+        the winning values are fetched — grouped per peer so each value
+        travels once — and installed WITH the winner's timestamp so
+        ordering metadata propagates. Absence never wins: there are no
+        tombstones, so a fresh write seen by one node is never destroyed by
+        peers that merely haven't received it yet; deletions propagate
+        through the replication layer's LWW events (and through PAIRWISE
+        sync, which keeps the reference's full local := remote semantics).
+        Every node running this same deterministic rule converges the
+        cluster to the LWW-merged union keyspace. Timestamps are wall
+        clocks — cross-node skew trades accuracy for availability exactly
+        like the reference's replication LWW (replication.rs:289-290).
+
+        The reference has no analog: its sync is strictly pairwise and
+        full-transfer (/root/reference/src/sync.rs:56-87).
+        """
+        with span("anti_entropy.sync_multi", peers=",".join(peers)) as rec:
+            report = self._sync_multi(peers)
+            rec["divergent"] = report.divergent_union
+            get_metrics().inc("anti_entropy.multi_syncs")
+            get_metrics().inc("anti_entropy.keys_repaired", report.set_keys)
+            return report
+
+    def _sync_multi(self, peers: list[str]) -> MultiSyncReport:
+        import numpy as np
+
+        from merklekv_tpu.merkle.diff import (
+            align_replicas,
+            divergence_masks,
+            divergence_masks_np,
+        )
+
+        t0 = time.perf_counter()
+        report = MultiSyncReport(peers=list(peers))
+
+        # Gather peer leaf-hash+ts maps; a down peer is skipped this cycle.
+        clients: list[Optional[MerkleKVClient]] = []
+        peer_hashes: list[dict[bytes, tuple[bytes, int]]] = []
+        for peer in peers:
+            host, _, port = peer.rpartition(":")
+            try:
+                c = MerkleKVClient(host, int(port), timeout=self._timeout)
+                c.connect()
+                raw = c.leaf_hashes_ts()
+            except Exception as e:
+                report.details.append(f"{peer}: unreachable ({e!r})")
+                clients.append(None)
+                peer_hashes.append({})
+                continue
+            clients.append(c)
+            peer_hashes.append(_decode_leaf_map(raw))
+        live = [i for i, c in enumerate(clients) if c is not None]
+        try:
+            if not live:
+                report.seconds = time.perf_counter() - t0
+                return report
+
+            local = {k: v for k, v in self._engine.snapshot()}
+            use_device = self._use_device(
+                len(set(local).union(*[set(p) for p in peer_hashes]))
+            )
+            local_hashes = _leaf_map(sorted(local.items()), use_device)
+
+            # Replica 0 = local; only live peers join the arbitration.
+            peer_maps = [peer_hashes[i] for i in live]
+            replicas = [local_hashes] + [
+                {k: d for k, (d, _) in pm.items()} for pm in peer_maps
+            ]
+            aligned = align_replicas(replicas)
+            report.union_keys = aligned.n_keys
+            if aligned.n_keys == 0:
+                report.seconds = time.perf_counter() - t0
+                return report
+            if use_device:
+                from merklekv_tpu.utils.jaxenv import ensure_platform
+
+                ensure_platform()
+                masks = np.asarray(
+                    divergence_masks(aligned.digests, aligned.present)
+                )
+            else:
+                masks = divergence_masks_np(aligned.digests, aligned.present)
+            report.per_peer_divergent = {
+                peers[i]: int(masks[slot].sum())
+                for slot, i in enumerate(live, start=1)
+            }
+            divergent = np.nonzero(masks.any(axis=0))[0]
+            report.divergent_union = int(len(divergent))
+
+            # One vectorized conversion: digest bytes for the divergent
+            # columns of every replica (the per-key loop below only
+            # byte-compares).
+            n_div = len(divergent)
+            sub = np.ascontiguousarray(
+                aligned.digests[:, divergent, :]
+            ).astype(">u4")
+            raw_digests = sub.tobytes()
+
+            def dig(r: int, j: int) -> bytes:
+                off = (r * n_div + j) * 32
+                return raw_digests[off : off + 32]
+
+            # Per-key LWW among replicas HOLDING the key (absence never
+            # wins — see docstring): newest ts, then larger digest.
+            # wants[peer_slot] = (key, winner_ts) pairs that peer serves.
+            wants: dict[int, list[tuple[bytes, int]]] = {}
+            for j, i in enumerate(divergent):
+                key = aligned.keys[i]
+                best: Optional[tuple[int, bytes]] = None
+                for slot in range(len(replicas)):
+                    if not aligned.present[slot, i]:
+                        continue
+                    if slot == 0:
+                        ts = self._engine.get_ts(key) or 0
+                    else:
+                        ts = peer_maps[slot - 1][key][1]
+                    cand = (ts, dig(slot, j))
+                    if best is None or cand > best:
+                        best = cand
+                if best is None:
+                    continue
+                winner_ts, winner = best
+                local_d = dig(0, j) if aligned.present[0, i] else None
+                if winner == local_d:
+                    continue  # local already holds the winning state
+                for slot, r in enumerate(live, start=1):
+                    if aligned.present[slot, i] and dig(slot, j) == winner:
+                        wants.setdefault(r, []).append((key, winner_ts))
+                        break
+
+            for r, pairs in wants.items():
+                values = self._fetch_values(clients[r], [k for k, _ in pairs])
+                report.values_fetched += len(values)
+                for k, ts in pairs:
+                    if k in values:
+                        self._repair_set(k, values[k], ts)
+                        report.set_keys += 1
+        finally:
+            for c in clients:
+                if c is not None:
+                    c.close()
+
+        report.seconds = time.perf_counter() - t0
+        self.last_multi_report = report
+        return report
 
     def _use_device(self, n_union: int) -> bool:
         return self._device == "tpu" or (
@@ -262,6 +447,9 @@ class SyncManager:
         use_device: bool,
     ) -> list[bytes]:
         if use_device:
+            from merklekv_tpu.utils.jaxenv import ensure_platform
+
+            ensure_platform()
             from merklekv_tpu.merkle.diff import diff_keys_pair
 
             return diff_keys_pair(local_hashes, remote_hashes)
@@ -304,11 +492,26 @@ class SyncManager:
         return out
 
     # -- periodic loop ---------------------------------------------------------
-    def start_loop(self, peers: list[str], interval_seconds: float) -> None:
-        """Periodic anti-entropy against each "host:port" peer."""
+    def start_loop(
+        self,
+        peers: list[str],
+        interval_seconds: float,
+        multi_peer: bool = False,
+    ) -> None:
+        """Periodic anti-entropy: pairwise per peer, or one fused
+        multi-peer arbitration cycle when ``multi_peer`` is set."""
 
         def run() -> None:
             while not self._stop.wait(interval_seconds):
+                if multi_peer:
+                    try:
+                        self.sync_multi(peers)
+                    except Exception:
+                        # Retried next round — but never silently: a loop
+                        # that throws every cycle looks like a healthy
+                        # no-op without this counter.
+                        get_metrics().inc("anti_entropy.loop_errors")
+                    continue
                 for peer in peers:
                     if self._stop.is_set():
                         return
@@ -318,6 +521,7 @@ class SyncManager:
                     except Exception:
                         # Peer down: anti-entropy retries next round; failure
                         # detection surfaces through last_report staleness.
+                        get_metrics().inc("anti_entropy.loop_errors")
                         continue
 
         self._stop.clear()

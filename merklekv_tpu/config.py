@@ -41,6 +41,9 @@ class AntiEntropyConfig:
     # "cpu" forces the host diff path; "auto" uses the TPU engine when the
     # keyspace is large enough to amortize a device round-trip.
     engine: str = "auto"
+    # true: each cycle gathers ALL peers' leaf hashes and arbitrates per key
+    # in one fused [R, N] diff program; false: pairwise local := peer syncs.
+    multi_peer: bool = False
 
 
 @dataclass
@@ -89,6 +92,8 @@ class Config:
             cfg.anti_entropy.peers = [str(p) for p in ae["peers"]]
         if "engine" in ae:
             cfg.anti_entropy.engine = str(ae["engine"])
+        if "multi_peer" in ae:
+            cfg.anti_entropy.multi_peer = bool(ae["multi_peer"])
         cfg.replication.resolve_env()
         return cfg
 

@@ -89,6 +89,17 @@ def divergence_masks(digests: jax.Array, present: jax.Array) -> jax.Array:
     return (present != ref_p) | (both_present & ~same_digest)
 
 
+def divergence_masks_np(digests: np.ndarray, present: np.ndarray) -> np.ndarray:
+    """Host-side twin of :func:`divergence_masks` for small keyspaces where
+    initializing an accelerator backend is not worth it (and, in spawned
+    server processes, must be avoided unless explicitly configured)."""
+    ref_d = digests[0:1]
+    ref_p = present[0:1]
+    same_digest = (digests == ref_d).all(axis=-1)
+    both_present = present & ref_p
+    return (present != ref_p) | (both_present & ~same_digest)
+
+
 @jax.jit
 def _any_divergent(digests: jax.Array, present: jax.Array) -> jax.Array:
     """[N] bool: key diverges between ANY pair of replicas (union view)."""

@@ -74,6 +74,25 @@ int mkv_engine_set(void* h, const char* key, int klen, const char* val,
              : 0;
 }
 
+int mkv_engine_set_with_ts(void* h, const char* key, int klen,
+                           const char* val, int vlen,
+                           unsigned long long ts) {
+  return static_cast<Engine*>(h)->set_with_ts(std::string(key, size_t(klen)),
+                                              std::string(val, size_t(vlen)),
+                                              uint64_t(ts))
+             ? 1
+             : 0;
+}
+
+// Returns 1 and writes the last-write unix-ns timestamp if present, else 0.
+int mkv_engine_get_ts(void* h, const char* key, int klen,
+                      unsigned long long* out_ts) {
+  auto ts = static_cast<Engine*>(h)->get_ts(std::string(key, size_t(klen)));
+  if (!ts) return 0;
+  *out_ts = *ts;
+  return 1;
+}
+
 int mkv_engine_del(void* h, const char* key, int klen) {
   return static_cast<Engine*>(h)->del(std::string(key, size_t(klen))) ? 1 : 0;
 }
@@ -93,6 +112,13 @@ long long mkv_engine_memory_usage(void* h) {
 
 int mkv_engine_truncate(void* h) {
   return static_cast<Engine*>(h)->truncate() ? 1 : 0;
+}
+
+// Log compaction: rewrites the durable log as a snapshot of live state.
+// Returns 1 on success, 0 for engines without a log (mem) or on failure.
+int mkv_engine_compact(void* h) {
+  auto* log = dynamic_cast<mkv::LogEngine*>(static_cast<Engine*>(h));
+  return log && log->compact() ? 1 : 0;
 }
 
 int mkv_engine_sync(void* h) {

@@ -3,6 +3,7 @@
 #include <fcntl.h>
 #include <sys/stat.h>
 #include <sys/types.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -15,6 +16,12 @@
 namespace mkv {
 
 namespace {
+
+uint64_t now_ns() {
+  timespec ts;
+  ::clock_gettime(CLOCK_REALTIME, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+}
 
 // Full-string i64 parse with Rust `str::parse::<i64>` semantics: optional
 // +/-, decimal digits only, no whitespace, overflow is an error.
@@ -57,14 +64,27 @@ std::optional<std::string> MemEngine::get(const std::string& key) {
   std::shared_lock lk(s.mu);
   auto it = s.map.find(key);
   if (it == s.map.end()) return std::nullopt;
-  return it->second;
+  return it->second.value;
 }
 
 bool MemEngine::set(const std::string& key, const std::string& value) {
+  return set_with_ts(key, value, now_ns());
+}
+
+bool MemEngine::set_with_ts(const std::string& key, const std::string& value,
+                            uint64_t ts) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  s.map[key] = value;
+  s.map[key] = Entry{value, ts};
   return true;
+}
+
+std::optional<uint64_t> MemEngine::get_ts(const std::string& key) {
+  Shard& s = shard_for(key);
+  std::shared_lock lk(s.mu);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return std::nullopt;
+  return it->second.ts;
 }
 
 bool MemEngine::del(const std::string& key) {
@@ -105,7 +125,7 @@ size_t MemEngine::memory_usage() {
   size_t n = 0;
   for (Shard& s : shards_) {
     std::shared_lock lk(s.mu);
-    for (const auto& [k, v] : s.map) n += k.size() + v.size();
+    for (const auto& [k, e] : s.map) n += k.size() + e.value.size();
   }
   return n;
 }
@@ -115,12 +135,12 @@ Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
   std::unique_lock lk(s.mu);
   int64_t cur = 0;
   auto it = s.map.find(key);
-  if (it != s.map.end() && !parse_i64(it->second, &cur)) {
+  if (it != s.map.end() && !parse_i64(it->second.value, &cur)) {
     return Result<int64_t>::Err(not_a_number(key));
   }
   // Wrapping add (reference release-mode semantics).
   int64_t next = int64_t(uint64_t(cur) + uint64_t(delta));
-  s.map[key] = std::to_string(next);
+  s.map[key] = Entry{std::to_string(next), now_ns()};
   return Result<int64_t>::Ok(next);
 }
 
@@ -141,11 +161,11 @@ Result<std::string> MemEngine::splice(const std::string& key,
   if (it == s.map.end()) {
     next = value;
   } else if (append) {
-    next = it->second + value;
+    next = it->second.value + value;
   } else {
-    next = value + it->second;
+    next = value + it->second.value;
   }
-  s.map[key] = next;
+  s.map[key] = Entry{next, now_ns()};
   return Result<std::string>::Ok(next);
 }
 
@@ -171,7 +191,7 @@ std::vector<std::pair<std::string, std::string>> MemEngine::snapshot() {
   std::vector<std::pair<std::string, std::string>> out;
   for (Shard& s : shards_) {
     std::shared_lock lk(s.mu);
-    for (const auto& kv : s.map) out.push_back(kv);
+    for (const auto& [k, e] : s.map) out.emplace_back(k, e.value);
   }
   std::sort(out.begin(), out.end(),
             [](const auto& a, const auto& b) { return a.first < b.first; });
@@ -180,14 +200,19 @@ std::vector<std::pair<std::string, std::string>> MemEngine::snapshot() {
 
 // ------------------------------------------------------------- LogEngine
 //
-// Log record: u8 op (1=SET, 2=DEL, 3=TRUNCATE) | u32 klen | u32 vlen |
-// key bytes | value bytes, little-endian lengths. A torn tail record (short
-// read) is discarded on replay.
+// Log record: u8 op | u32 klen | u32 vlen | [u64 ts] | key bytes | value
+// bytes, little-endian integers. Ops: 1=SET (legacy, no ts field),
+// 2=DEL, 3=TRUNCATE, 4=SET_TS (carries the entry's last-write unix-ns
+// timestamp so LWW ordering survives restart). New records are written as
+// SET_TS; legacy SET records replay with ts=0 ("unknown age" — loses every
+// LWW tie, which is the conservative choice). A torn tail record (short
+// read) is discarded on replay and truncated from the file.
 
 namespace {
 constexpr uint8_t kOpSet = 1;
 constexpr uint8_t kOpDel = 2;
 constexpr uint8_t kOpTruncate = 3;
+constexpr uint8_t kOpSetTs = 4;
 
 bool read_exact(int fd, void* buf, size_t len) {
   uint8_t* p = static_cast<uint8_t*>(buf);
@@ -234,26 +259,30 @@ LogEngine::LogEngine(const std::string& dir) {
           !read_exact(rfd, &vlen, 4)) {
         break;
       }
+      const off_t ts_size = (op == kOpSetTs) ? 8 : 0;
+      const off_t rec_size = off_t(9) + ts_size + klen + vlen;
       // Torn-tail test by exact arithmetic, not a size cap: a record whose
       // claimed payload runs past the end of the file cannot be complete
       // (and allocating from a garbage length would be an OOM hazard).
       // Legitimately large records replay fine.
-      if (off_t(9) + off_t(klen) + off_t(vlen) > end - good) break;
+      if (rec_size > end - good) break;
+      uint64_t ts = 0;
+      if (ts_size && !read_exact(rfd, &ts, 8)) break;
       std::string key(klen, '\0'), value(vlen, '\0');
       if (klen && !read_exact(rfd, key.data(), klen)) break;
       if (vlen && !read_exact(rfd, value.data(), vlen)) break;
-      if (op == kOpSet) {
-        mem_.set(key, value);
+      if (op == kOpSet || op == kOpSetTs) {
+        mem_.set_with_ts(key, value, ts);
       } else if (op == kOpDel) {
         mem_.del(key);
       } else if (op == kOpTruncate) {
         mem_.truncate();
       } else {
-        // Unknown op: this format has no forward-compat records (v1 writes
-        // only 1..3), so these bytes are corruption and get cut too.
+        // Unknown op: this format has no forward-compat records, so these
+        // bytes are corruption and get cut too.
         break;
       }
-      good += off_t(9) + klen + vlen;
+      good += rec_size;
     }
     ::close(rfd);
     if (end > good) ::truncate(path_.c_str(), good);
@@ -269,14 +298,16 @@ LogEngine::~LogEngine() {
 }
 
 bool LogEngine::append_record(uint8_t op, const std::string& key,
-                              const std::string& value) {
+                              const std::string& value, uint64_t ts) {
   if (fd_ < 0) return false;
   std::string rec;
-  rec.reserve(9 + key.size() + value.size());
+  const bool with_ts = op == kOpSetTs;
+  rec.reserve(9 + (with_ts ? 8 : 0) + key.size() + value.size());
   rec.push_back(char(op));
   uint32_t klen = uint32_t(key.size()), vlen = uint32_t(value.size());
   rec.append(reinterpret_cast<const char*>(&klen), 4);
   rec.append(reinterpret_cast<const char*>(&vlen), 4);
+  if (with_ts) rec.append(reinterpret_cast<const char*>(&ts), 8);
   rec.append(key);
   rec.append(value);
   return write_all(fd_, rec.data(), rec.size());
@@ -287,16 +318,25 @@ std::optional<std::string> LogEngine::get(const std::string& key) {
 }
 
 bool LogEngine::set(const std::string& key, const std::string& value) {
+  return set_with_ts(key, value, now_ns());
+}
+
+bool LogEngine::set_with_ts(const std::string& key, const std::string& value,
+                            uint64_t ts) {
   // Mutations serialize on log_mu_ so replay order matches final state.
   std::unique_lock lk(log_mu_);
-  if (!mem_.set(key, value)) return false;
-  return append_record(kOpSet, key, value);
+  if (!mem_.set_with_ts(key, value, ts)) return false;
+  return append_record(kOpSetTs, key, value, ts);
+}
+
+std::optional<uint64_t> LogEngine::get_ts(const std::string& key) {
+  return mem_.get_ts(key);
 }
 
 bool LogEngine::del(const std::string& key) {
   std::unique_lock lk(log_mu_);
   bool existed = mem_.del(key);
-  if (existed) append_record(kOpDel, key, "");
+  if (existed) append_record(kOpDel, key, "", 0);
   return existed;
 }
 
@@ -312,14 +352,20 @@ size_t LogEngine::memory_usage() { return mem_.memory_usage(); }
 Result<int64_t> LogEngine::increment(const std::string& key, int64_t amount) {
   std::unique_lock lk(log_mu_);
   auto r = mem_.increment(key, amount);
-  if (r.ok) append_record(kOpSet, key, std::to_string(r.value));
+  if (r.ok) {
+    append_record(kOpSetTs, key, std::to_string(r.value),
+                  mem_.get_ts(key).value_or(0));
+  }
   return r;
 }
 
 Result<int64_t> LogEngine::decrement(const std::string& key, int64_t amount) {
   std::unique_lock lk(log_mu_);
   auto r = mem_.decrement(key, amount);
-  if (r.ok) append_record(kOpSet, key, std::to_string(r.value));
+  if (r.ok) {
+    append_record(kOpSetTs, key, std::to_string(r.value),
+                  mem_.get_ts(key).value_or(0));
+  }
   return r;
 }
 
@@ -327,7 +373,7 @@ Result<std::string> LogEngine::append(const std::string& key,
                                       const std::string& value) {
   std::unique_lock lk(log_mu_);
   auto r = mem_.append(key, value);
-  if (r.ok) append_record(kOpSet, key, r.value);
+  if (r.ok) append_record(kOpSetTs, key, r.value, mem_.get_ts(key).value_or(0));
   return r;
 }
 
@@ -335,7 +381,7 @@ Result<std::string> LogEngine::prepend(const std::string& key,
                                        const std::string& value) {
   std::unique_lock lk(log_mu_);
   auto r = mem_.prepend(key, value);
-  if (r.ok) append_record(kOpSet, key, r.value);
+  if (r.ok) append_record(kOpSetTs, key, r.value, mem_.get_ts(key).value_or(0));
   return r;
 }
 
@@ -365,10 +411,12 @@ bool LogEngine::compact() {
   if (nfd < 0) return false;
   for (const auto& [k, v] : snap) {
     std::string rec;
-    rec.push_back(char(kOpSet));
+    rec.push_back(char(kOpSetTs));
     uint32_t klen = uint32_t(k.size()), vlen = uint32_t(v.size());
+    uint64_t ts = mem_.get_ts(k).value_or(0);
     rec.append(reinterpret_cast<const char*>(&klen), 4);
     rec.append(reinterpret_cast<const char*>(&vlen), 4);
+    rec.append(reinterpret_cast<const char*>(&ts), 8);
     rec.append(k);
     rec.append(v);
     if (!write_all(nfd, rec.data(), rec.size())) {

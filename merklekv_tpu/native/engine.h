@@ -38,6 +38,14 @@ class Engine {
 
   virtual std::optional<std::string> get(const std::string& key) = 0;
   virtual bool set(const std::string& key, const std::string& value) = 0;
+  // Install a value with an explicit last-write timestamp (unix ns).
+  // Used by LWW repair paths (anti-entropy, replication apply) so ordering
+  // metadata propagates with the value instead of being re-stamped "now".
+  virtual bool set_with_ts(const std::string& key, const std::string& value,
+                           uint64_t ts) = 0;
+  // Last-write timestamp (unix ns) of a present key; nullopt if absent.
+  // Plain writes stamp the wall clock; replayed legacy log records carry 0.
+  virtual std::optional<uint64_t> get_ts(const std::string& key) = 0;
   virtual bool del(const std::string& key) = 0;  // true if the key existed
   virtual bool exists(const std::string& key) = 0;
   // Sorted keys with the given prefix ("" = all).
@@ -66,6 +74,9 @@ class MemEngine : public Engine {
 
   std::optional<std::string> get(const std::string& key) override;
   bool set(const std::string& key, const std::string& value) override;
+  bool set_with_ts(const std::string& key, const std::string& value,
+                   uint64_t ts) override;
+  std::optional<uint64_t> get_ts(const std::string& key) override;
   bool del(const std::string& key) override;
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
@@ -82,9 +93,13 @@ class MemEngine : public Engine {
   std::vector<std::pair<std::string, std::string>> snapshot() override;
 
  private:
+  struct Entry {
+    std::string value;
+    uint64_t ts = 0;  // last-write unix ns
+  };
   struct Shard {
     mutable std::shared_mutex mu;
-    std::unordered_map<std::string, std::string> map;
+    std::unordered_map<std::string, Entry> map;
   };
   Shard& shard_for(const std::string& key);
   Result<int64_t> add(const std::string& key, int64_t delta);
@@ -106,6 +121,9 @@ class LogEngine : public Engine {
 
   std::optional<std::string> get(const std::string& key) override;
   bool set(const std::string& key, const std::string& value) override;
+  bool set_with_ts(const std::string& key, const std::string& value,
+                   uint64_t ts) override;
+  std::optional<uint64_t> get_ts(const std::string& key) override;
   bool del(const std::string& key) override;
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
@@ -125,10 +143,8 @@ class LogEngine : public Engine {
   bool compact();
 
  private:
-  bool log_set(const std::string& key, const std::string& value);
-  bool log_del(const std::string& key);
   bool append_record(uint8_t op, const std::string& key,
-                     const std::string& value);
+                     const std::string& value, uint64_t ts);
 
   MemEngine mem_;
   std::string path_;

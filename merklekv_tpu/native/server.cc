@@ -393,7 +393,11 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         if (!v) continue;  // deleted between scan and get
         uint8_t d[32];
         leaf_hash(k, *v, d);
-        out += k + " " + digest_hex(d) + "\r\n";
+        // Trailing last-write timestamp (unix ns) feeds the peer's LWW
+        // arbitration; older readers that split on the last space still
+        // parse key+digest correctly.
+        out += k + " " + digest_hex(d) + " " +
+               std::to_string(engine_->get_ts(k).value_or(0)) + "\r\n";
         ++listed;
       }
       if (listed != keys.size()) {

@@ -84,6 +84,14 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_int,
     ]
+    lib.mkv_engine_set_with_ts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_ulonglong,
+    ]
+    lib.mkv_engine_get_ts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        P(ctypes.c_ulonglong),
+    ]
     lib.mkv_engine_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.mkv_engine_exists.argtypes = lib.mkv_engine_del.argtypes
     lib.mkv_engine_dbsize.restype = ctypes.c_longlong
@@ -91,6 +99,7 @@ def _load() -> ctypes.CDLL:
     lib.mkv_engine_memory_usage.restype = ctypes.c_longlong
     lib.mkv_engine_memory_usage.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_truncate.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_compact.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_sync.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_increment.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong,
@@ -184,6 +193,23 @@ class NativeEngine:
         if not self._lib.mkv_engine_set(self._h, key, len(key), value, len(value)):
             raise NativeError("set failed")
 
+    def set_with_ts(self, key: bytes, value: bytes, ts: int) -> None:
+        """Install a value with an explicit last-write timestamp (unix ns) —
+        LWW repair paths propagate ordering metadata with the value."""
+        if not self._lib.mkv_engine_set_with_ts(
+            self._h, key, len(key), value, len(value), ts
+        ):
+            raise NativeError("set_with_ts failed")
+
+    def get_ts(self, key: bytes) -> Optional[int]:
+        """Last-write unix-ns timestamp of a present key, else None."""
+        ts = ctypes.c_ulonglong()
+        if not self._lib.mkv_engine_get_ts(
+            self._h, key, len(key), ctypes.byref(ts)
+        ):
+            return None
+        return int(ts.value)
+
     def delete(self, key: bytes) -> bool:
         return bool(self._lib.mkv_engine_del(self._h, key, len(key)))
 
@@ -201,6 +227,11 @@ class NativeEngine:
 
     def sync(self) -> None:
         self._lib.mkv_engine_sync(self._h)
+
+    def compact(self) -> bool:
+        """Rewrite the durable log as a live-state snapshot (drops
+        tombstones). False for engines without a log."""
+        return bool(self._lib.mkv_engine_compact(self._h))
 
     def increment(self, key: bytes, amount: int = 1) -> int:
         return self._numeric(self._lib.mkv_engine_increment, key, amount)

@@ -1,0 +1,27 @@
+"""JAX platform selection for server processes.
+
+The deployment environment may pin jax to a single-process accelerator
+backend (one tunneled TPU chip) via sitecustomize. Multi-process harnesses
+(N spawned servers on one host) must not race for it, so serving-path code
+honors ``MERKLEKV_JAX_PLATFORM`` (e.g. "cpu") — applied through
+``jax.config.update`` because the deployment pin overrides plain env vars.
+Must run before the first computation initializes a backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["ensure_platform"]
+
+
+def ensure_platform() -> None:
+    plat = os.environ.get("MERKLEKV_JAX_PLATFORM")
+    if not plat:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except RuntimeError:
+        pass  # backend already initialized; keep whatever it is

@@ -142,6 +142,74 @@ def test_cross_process_sync_command(cluster):
         assert c1.hash() == c2.hash()
 
 
+def test_three_process_multi_peer_convergence(tmp_path):
+    """3 server processes with the fused multi-peer anti-entropy loop:
+    disjoint writes converge to one root within a couple of cycles."""
+    procs, ports = [], []
+    try:
+        # Start all three first to learn their ports (port 0), then restart
+        # is avoided by passing peers via a second wave: instead, spawn on
+        # fixed free ports chosen up front.
+        import socket as s
+
+        fixed = []
+        socks = []
+        for _ in range(3):
+            sk = s.socket()
+            sk.bind(("127.0.0.1", 0))
+            fixed.append(sk.getsockname()[1])
+            socks.append(sk)
+        for sk in socks:
+            sk.close()
+        for i in range(3):
+            peers = [f'"127.0.0.1:{fixed[j]}"' for j in range(3) if j != i]
+            cfg = tmp_path / f"m{i}.toml"
+            cfg.write_text(
+                f"""
+host = "127.0.0.1"
+port = {fixed[i]}
+engine = "mem"
+
+[anti_entropy]
+enabled = true
+interval_seconds = 0.3
+multi_peer = true
+engine = "cpu"
+peers = [{", ".join(peers)}]
+"""
+            )
+            p = _spawn(["-m", "merklekv_tpu", "--config", str(cfg)])
+            procs.append(p)
+            _port_from(p)
+            _wait_port(fixed[i])
+            ports.append(fixed[i])
+
+        clients = [MerkleKVClient("127.0.0.1", pt).connect() for pt in ports]
+        try:
+            for i in range(30):
+                clients[i % 3].set(f"mp{i:03d}", f"v{i}")
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                roots = {c.hash() for c in clients}
+                if len(roots) == 1 and clients[0].dbsize() == 30:
+                    break
+                time.sleep(0.1)
+            assert len({c.hash() for c in clients}) == 1
+            for c in clients:
+                assert c.dbsize() == 30
+        finally:
+            for c in clients:
+                c.close()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def test_persistence_across_restart(tmp_path):
     data = tmp_path / "data"
     p = _spawn(

@@ -217,6 +217,104 @@ def test_sync_flags_over_protocol(two_nodes):
         node.stop()
 
 
+@pytest.fixture
+def three_nodes():
+    nodes = []
+    for _ in range(3):
+        eng = NativeEngine("mem")
+        srv = NativeServer(eng, "127.0.0.1", 0)
+        srv.start()
+        nodes.append((eng, srv))
+    yield nodes
+    for eng, srv in nodes:
+        srv.close()
+        eng.close()
+
+
+def test_sync_multi_lww_arbitration(three_nodes):
+    """One fused [R,N] diff + per-key LWW repair across all peers."""
+    (local_eng, _), (p1_eng, p1_srv), (p2_eng, p2_srv) = three_nodes
+    base = {f"mk{i:03d}": f"v{i}" for i in range(50)}
+    fill(local_eng, base)
+    fill(p1_eng, base)
+    fill(p2_eng, base)
+    # Both peers later overwrote mk001: their write is newer -> local
+    # takes it.
+    p1_eng.set(b"mk001", b"newer")
+    p2_eng.set(b"mk001", b"newer")
+    # A fresh local-only write: absence never wins, so it must survive.
+    local_eng.set(b"only-local", b"x")
+    # Peers hold a key local lacks.
+    p1_eng.set(b"peer-key", b"shared")
+    p2_eng.set(b"peer-key", b"shared")
+    # Three-way conflict written in sequence: the LAST writer (p2) wins.
+    local_eng.set(b"split", b"va")
+    p1_eng.set(b"split", b"vb")
+    p2_eng.set(b"split", b"vc")
+
+    mgr = SyncManager(local_eng, device="cpu")
+    peers = [f"127.0.0.1:{p1_srv.port}", f"127.0.0.1:{p2_srv.port}"]
+    report = mgr.sync_multi(peers)
+
+    assert local_eng.get(b"mk001") == b"newer"
+    assert local_eng.get(b"only-local") == b"x"  # fresh write survives
+    assert local_eng.get(b"peer-key") == b"shared"
+    assert local_eng.get(b"split") == b"vc"  # newest write wins
+    # The winner's timestamp propagated with the value.
+    assert local_eng.get_ts(b"split") == p2_eng.get_ts(b"split")
+    assert set(report.per_peer_divergent) == set(peers)
+    assert report.divergent_union >= 4
+    # Targeted transfer: only winning values travelled, never the keyspace.
+    assert report.values_fetched <= report.divergent_union
+
+
+def test_sync_multi_fresh_local_update_survives(three_nodes):
+    """An update of an EXISTING key made only locally (newest ts) must not
+    be rolled back by stale majority peers — the LWW guarantee."""
+    (local_eng, _), (p1_eng, p1_srv), (p2_eng, p2_srv) = three_nodes
+    for eng in (local_eng, p1_eng, p2_eng):
+        eng.set(b"shared", b"old")
+    local_eng.set(b"shared", b"fresh-update")  # newest write, local only
+
+    mgr = SyncManager(local_eng, device="cpu")
+    report = mgr.sync_multi(
+        [f"127.0.0.1:{p1_srv.port}", f"127.0.0.1:{p2_srv.port}"]
+    )
+    assert local_eng.get(b"shared") == b"fresh-update"
+    assert report.set_keys == 0
+
+
+def test_sync_multi_skips_dead_peer(three_nodes):
+    (local_eng, _), (p1_eng, p1_srv), _ = three_nodes
+    fill(p1_eng, {"live": "yes"})
+    mgr = SyncManager(local_eng, device="cpu")
+    report = mgr.sync_multi(["127.0.0.1:1", f"127.0.0.1:{p1_srv.port}"])
+    assert local_eng.get(b"live") == b"yes"
+    assert any("unreachable" in d for d in report.details)
+
+
+def test_sync_multi_all_nodes_converge(three_nodes):
+    """Every node running the same deterministic cycle reaches one root."""
+    engines = [e for e, _ in three_nodes]
+    servers = [s for _, s in three_nodes]
+    import random
+
+    rng = random.Random(7)
+    for i in range(60):
+        owner = rng.randrange(3)
+        engines[owner].set(b"ck%03d" % i, b"v%d" % i)
+    for round_ in range(3):  # a few cycles to propagate transitively
+        for me in range(3):
+            peers = [
+                f"127.0.0.1:{servers[j].port}" for j in range(3) if j != me
+            ]
+            SyncManager(engines[me], device="cpu").sync_multi(peers)
+    roots = {e.merkle_root() for e in engines}
+    assert len(roots) == 1
+    # Union semantics: every disjoint write survives on every node.
+    assert engines[0].dbsize() == 60
+
+
 def test_periodic_loop_repairs(two_nodes):
     (local_eng, _), (remote_eng, remote_srv) = two_nodes
     fill(remote_eng, {"auto": "repaired"})
