@@ -187,6 +187,9 @@ def test_verify_failure_raises(two_nodes):
         def set(self, k, v):
             return True  # dropped
 
+        def set_with_ts(self, k, v, ts):
+            return True  # dropped — the hash-first repair path writes here
+
         def delete(self, k):
             return False
 
