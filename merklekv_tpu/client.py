@@ -238,21 +238,31 @@ class MerkleKVClient:
         return resp.rsplit(" ", 1)[-1]
 
     def leaf_hashes(self, prefix: str = "") -> dict[str, str]:
-        """Per-key leaf digests (hex) — the anti-entropy narrowing fetch."""
-        return {k: h for k, (h, _) in self.leaf_hashes_ts(prefix).items()}
+        """Per-key leaf digests (hex) of LIVE keys — the anti-entropy
+        narrowing fetch. Tombstone lines are filtered out."""
+        return {
+            k: h
+            for k, (h, _) in self.leaf_hashes_ts(prefix).items()
+            if h is not None
+        }
 
-    def leaf_hashes_ts(self, prefix: str = "") -> dict[str, tuple[str, int]]:
-        """Per-key (leaf digest hex, last-write unix-ns ts). Servers that
-        predate the ts field yield ts 0 ("unknown age")."""
+    def leaf_hashes_ts(
+        self, prefix: str = ""
+    ) -> dict[str, tuple[Optional[str], int]]:
+        """Per-key (leaf digest hex, last-write unix-ns ts). A digest of
+        None marks a TOMBSTONE: the key was deleted at that ts (wire digest
+        field "-"). Servers that predate the ts field yield ts 0
+        ("unknown age")."""
         cmd = f"LEAFHASHES {prefix}" if prefix else "LEAFHASHES"
         n = _count_after(self._request(cmd), "HASHES ")
-        out: dict[str, tuple[str, int]] = {}
+        out: dict[str, tuple[Optional[str], int]] = {}
         for _ in range(n):
             parts = self._read_line().split(" ")
             # Keys cannot contain spaces (protocol rule), so lines are
-            # either "key hex" (legacy) or "key hex ts".
+            # either "key hex" (legacy) or "key hex|- ts".
             if len(parts) >= 3:
-                out[parts[0]] = (parts[1], int(parts[2]))
+                digest = None if parts[1] == "-" else parts[1]
+                out[parts[0]] = (digest, int(parts[2]))
             else:
                 out[parts[0]] = (parts[1], 0)
         return out

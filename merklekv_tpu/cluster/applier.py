@@ -10,7 +10,10 @@ deterministic tie-break from its LocalApplier double (change_event.rs:222-246):
 
 Improvements over the reference: the reference's `seen`/`last_ts` maps grow
 without bound and die with the process (replication.rs:277-278 TODO); here
-the dedupe set is LRU-bounded.
+the dedupe set is LRU-bounded, and when the store tracks per-key last-write
+timestamps (``store_ts_fn``), the LWW floor is read from the STORE — so the
+ordering survives an applier restart and agrees with anti-entropy repairs
+instead of maintaining a second, divergent in-memory ordering.
 """
 
 from __future__ import annotations
@@ -24,7 +27,23 @@ __all__ = ["LWWApplier"]
 
 
 class LWWApplier:
-    """Applies ChangeEvents onto set/delete callables (engine-agnostic)."""
+    """Applies ChangeEvents onto set/delete callables (engine-agnostic).
+
+    Callables:
+      set_fn(key, value)          — plain install (no ts tracking).
+      del_fn(key)                 — plain delete.
+      set_ts_fn(key, value, ts)   — install carrying the EVENT's ts; should
+                                    be LWW-conditional (engine set_if_newer)
+                                    when the store tracks timestamps.
+      del_ts_fn(key, ts)          — delete carrying the event's ts (engine
+                                    del_if_newer records the tombstone).
+      store_ts_fn(key) -> int     — the store's authoritative last-write
+                                    floor for a key: max(entry ts, tombstone
+                                    ts), 0 if unknown. Consulted IN ADDITION
+                                    to the in-memory map, so a restarted
+                                    applier (empty maps) still rejects stale
+                                    events against repaired/persisted state.
+    """
 
     def __init__(
         self,
@@ -32,13 +51,14 @@ class LWWApplier:
         del_fn: Callable[[bytes], None],
         max_seen: int = 1 << 20,
         set_ts_fn: Optional[Callable[[bytes, bytes, int], None]] = None,
+        del_ts_fn: Optional[Callable[[bytes, int], None]] = None,
+        store_ts_fn: Optional[Callable[[bytes], int]] = None,
     ) -> None:
         self._set = set_fn
-        # When the store tracks per-key last-write timestamps, applies go
-        # through set_ts_fn with the EVENT's ts so anti-entropy LWW and
-        # replication LWW agree on ordering.
         self._set_ts = set_ts_fn
         self._del = del_fn
+        self._del_ts = del_ts_fn
+        self._store_ts = store_ts_fn
         self._seen: OrderedDict[bytes, None] = OrderedDict()
         self._max_seen = max_seen
         self._last_ts: dict[str, int] = {}
@@ -52,19 +72,29 @@ class LWWApplier:
         if ev.op_id in self._seen:
             self.skipped_dup += 1
             return False
-        last_ts = self._last_ts.get(ev.key, 0)
+        key = ev.key.encode("utf-8")
+        mem_ts = self._last_ts.get(ev.key, 0)
+        last_ts = mem_ts
+        if self._store_ts is not None:
+            last_ts = max(last_ts, self._store_ts(key))
         if ev.ts < last_ts:
             self._remember(ev.op_id)
             self.skipped_lww += 1
             return False
-        if ev.ts == last_ts and ev.op_id < self._last_op_id.get(ev.key, b"\0" * 16):
+        # op_id tie-break only against the in-memory record: the store
+        # tracks timestamps, not op ids. After a restart an equal-ts event
+        # re-applies — idempotent for redelivery, and cross-writer equal-ts
+        # conflicts still converge through anti-entropy's digest tie-break.
+        if ev.ts == mem_ts and ev.op_id < self._last_op_id.get(ev.key, b"\0" * 16):
             self._remember(ev.op_id)
             self.skipped_lww += 1
             return False
 
-        key = ev.key.encode("utf-8")
         if ev.op is OpKind.DEL:
-            self._del(key)
+            if self._del_ts is not None:
+                self._del_ts(key, ev.ts)
+            else:
+                self._del(key)
         elif ev.val is not None:
             # Post-op value semantics: INCR/DECR/APPEND/PREPEND all apply as
             # an absolute SET of the result (change_event.rs:17-19).

@@ -77,21 +77,38 @@ class Replicator:
         self._batch_listener = batch_listener
         self._mirror = mirror
 
-        # Remote applies install the EVENT's timestamp (set_with_ts), so
-        # replication LWW and anti-entropy LWW share one ordering; they also
-        # bypass the server's event queue (no echo loop), so the device
-        # mirror must be fed inline here.
+        # Remote applies install the EVENT's timestamp through the engine's
+        # LWW-conditional ops (set_if_newer / del_if_newer), so replication
+        # LWW, anti-entropy LWW, and the store's persisted ordering are ONE
+        # ordering — a replayed event older than a sync-repaired value is
+        # rejected at the shard lock, not re-installed. Applies also bypass
+        # the server's event queue (no echo loop), so the device mirror is
+        # fed inline here — only when the op actually changed state.
         def _set_ts(k: bytes, v: bytes, ts: int) -> None:
-            engine.set_with_ts(k, v, ts)
-            if mirror is not None:
+            if engine.set_if_newer(k, v, ts) and mirror is not None:
                 mirror.apply_one(k, v)
 
         def _del(k: bytes) -> None:
-            engine.delete(k)
-            if mirror is not None:
+            if engine.delete(k) and mirror is not None:
                 mirror.apply_one(k, None)
 
-        self._applier = LWWApplier(engine.set, _del, set_ts_fn=_set_ts)
+        def _del_ts(k: bytes, ts: int) -> None:
+            if engine.delete_if_newer(k, ts) and mirror is not None:
+                mirror.apply_one(k, None)
+
+        def _store_ts(k: bytes) -> int:
+            # The store's LWW floor: live entry ts or tombstone ts. Keeps a
+            # restarted applier (empty in-memory maps) from resurrecting
+            # state that anti-entropy or a prior run already superseded.
+            return max(engine.get_ts(k) or 0, engine.tombstone_ts(k) or 0)
+
+        self._applier = LWWApplier(
+            engine.set,
+            _del,
+            set_ts_fn=_set_ts,
+            del_ts_fn=_del_ts,
+            store_ts_fn=_store_ts,
+        )
         self._applier_mu = threading.Lock()
         # Spans drain..mirror-apply: a flush() must not return while another
         # thread holds drained-but-unapplied events, or device_root_hex's

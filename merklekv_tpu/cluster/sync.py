@@ -56,6 +56,7 @@ class MultiSyncReport:
     # peer -> divergence count vs local; unreachable peers are absent.
     per_peer_divergent: dict[str, int] = field(default_factory=dict)
     set_keys: int = 0
+    deleted_keys: int = 0  # keys removed because a peer's tombstone won LWW
     values_fetched: int = 0
     seconds: float = 0.0
     details: list[str] = field(default_factory=list)
@@ -96,11 +97,17 @@ def _leaf_map(items: list[tuple[bytes, bytes]], use_device: bool) -> dict[bytes,
 
 
 def _decode_leaf_map(
-    raw: dict[str, tuple[str, int]]
-) -> dict[bytes, tuple[bytes, int]]:
-    """LEAFHASHES wire payload -> {key bytes: (digest bytes, unix-ns ts)}."""
+    raw: dict[str, tuple[Optional[str], int]]
+) -> dict[bytes, tuple[Optional[bytes], int]]:
+    """LEAFHASHES wire payload -> {key bytes: (digest bytes | None, ts)}.
+
+    A None digest is a TOMBSTONE: the peer deleted the key at ts, and that
+    deletion competes in LWW arbitration like any write."""
     return {
-        k.encode("utf-8", "surrogateescape"): (bytes.fromhex(h), ts)
+        k.encode("utf-8", "surrogateescape"): (
+            bytes.fromhex(h) if h is not None else None,
+            ts,
+        )
         for k, (h, ts) in raw.items()
     }
 
@@ -207,34 +214,45 @@ class SyncManager:
         """Peer (leaf digest, last-write ts) map, or None if the peer can't
         serve LEAFHASHES."""
         try:
-            raw = client.leaf_hashes_ts()
+            # Decode INSIDE the try: a malformed digest line (corrupt peer,
+            # or a future wire extension this reader doesn't know) must
+            # degrade to the full-transfer fallback, not kill the cycle.
+            return _decode_leaf_map(client.leaf_hashes_ts())
         except Exception as e:
             report.details.append(f"LEAFHASHES unsupported: {e!r}")
             get_metrics().inc("anti_entropy.leafhash_fallbacks")
             return None
-        return _decode_leaf_map(raw)
 
     def _sync_hash_first(
         self,
         client: MerkleKVClient,
-        remote_hashes: dict[bytes, tuple[bytes, int]],
+        remote_hashes: dict[bytes, tuple[Optional[bytes], int]],
         report: SyncReport,
     ) -> None:
         local = {k: v for k, v in self._engine.snapshot()}
-        report.remote_keys = len(remote_hashes)
+        # Live digests and tombstones arrive in one LEAFHASHES payload;
+        # pairwise semantics stay strict local := remote over the LIVE
+        # keyspace, with remote tombstone timestamps adopted so the copied
+        # deletion keeps its original LWW position.
+        remote_digests = {
+            k: d for k, (d, _) in remote_hashes.items() if d is not None
+        }
+        remote_tombs = {
+            k: ts for k, (d, ts) in remote_hashes.items() if d is None
+        }
+        report.remote_keys = len(remote_digests)
         report.local_keys = len(local)
 
-        use_device = self._use_device(len(set(local) | set(remote_hashes)))
+        use_device = self._use_device(len(set(local) | set(remote_digests)))
         local_hashes = _leaf_map(sorted(local.items()), use_device)
-        remote_digests = {k: d for k, (d, _) in remote_hashes.items()}
         divergent = self._diff(local_hashes, remote_digests, use_device)
         report.divergent = len(divergent)
 
-        to_fetch = [k for k in divergent if k in remote_hashes]
+        to_fetch = [k for k in divergent if k in remote_digests]
         values = self._fetch_values(client, to_fetch)
         report.values_fetched = len(values)
         for k in divergent:
-            if k in remote_hashes:
+            if k in remote_digests:
                 if k in values:
                     # Propagate the peer's last-write ts with the value so
                     # LWW ordering metadata survives the repair.
@@ -243,7 +261,7 @@ class SyncManager:
                 # else: deleted on the peer between LEAFHASHES and MGET;
                 # the next cycle repairs it.
             else:
-                self._repair_delete(k)
+                self._repair_delete(k, tomb_ts=remote_tombs.get(k))
                 report.deleted_keys += 1
 
     # -- full path (reference behavior; --full or LEAFHASHES-less peer) -------
@@ -276,34 +294,70 @@ class SyncManager:
         if self._repair_listener is not None:
             self._repair_listener(k, v)
 
-    def _repair_delete(self, k: bytes) -> None:
-        self._engine.delete(k)
+    def _repair_set_lww(self, k: bytes, v: bytes, ts: int) -> bool:
+        """Conditional install for multi-peer repair: a local write or
+        deletion racing ahead of the fetched winner must not be clobbered."""
+        applied = self._engine.set_if_newer(k, v, ts)
+        if applied and self._repair_listener is not None:
+            self._repair_listener(k, v)
+        return applied
+
+    def _repair_delete(self, k: bytes, tomb_ts: Optional[int] = None) -> None:
+        """Pairwise repair deletion. With the peer's tombstone ts, adopt it
+        (the deletion keeps its LWW position); without one this is a MIRROR
+        copy of absence — delete_quiet, because fabricating a tombstone at
+        "now" would later kill disjoint writes cluster-wide."""
+        if tomb_ts is None:
+            if not hasattr(self._engine, "delete_quiet"):
+                self._engine.delete(k)  # engine doubles without quiet mode
+            else:
+                self._engine.delete_quiet(k)
+        else:
+            self._engine.delete_with_ts(k, tomb_ts)
         if self._repair_listener is not None:
             self._repair_listener(k, None)
+
+    def _repair_delete_lww(self, k: bytes, ts: int, was_present: bool) -> bool:
+        """Conditional deletion for multi-peer repair (peer tombstone won).
+
+        The listener fires on EVERY applied delete, not just when the
+        start-of-cycle snapshot saw the key: a replication event may have
+        installed it mid-cycle, and the device mirror must drop what the
+        engine just dropped (apply_one(k, None) is a no-op for absent
+        keys). ``was_present`` only scopes the report count."""
+        applied = self._engine.delete_if_newer(k, ts)
+        if applied and self._repair_listener is not None:
+            self._repair_listener(k, None)
+        return applied and was_present
 
     # -- multi-peer cycle -----------------------------------------------------
     def sync_multi(self, peers: list[str]) -> MultiSyncReport:
         """One anti-entropy cycle against ALL peers at once.
 
-        Gathers every peer's (leaf hash, last-write ts) pairs, stacks the
-        digests with the local map into one ``[R, N]`` divergence program
-        (merkle/diff.py), then arbitrates each divergent key by **per-key
-        LWW**: newest last-write timestamp wins; equal timestamps break
-        toward the lexicographically larger digest (deterministic). Only
-        the winning values are fetched — grouped per peer so each value
-        travels once — and installed WITH the winner's timestamp so
-        ordering metadata propagates. Absence never wins: there are no
-        tombstones, so a fresh write seen by one node is never destroyed by
-        peers that merely haven't received it yet; deletions propagate
-        through the replication layer's LWW events (and through PAIRWISE
-        sync, which keeps the reference's full local := remote semantics).
-        Every node running this same deterministic rule converges the
-        cluster to the LWW-merged union keyspace. Timestamps are wall
-        clocks — cross-node skew trades accuracy for availability exactly
-        like the reference's replication LWW (replication.rs:289-290).
+        Gathers every peer's (leaf hash, last-write ts) pairs AND tombstones
+        (deletion records with timestamps), stacks the live digests with the
+        local map into one ``[R, N]`` divergence program (merkle/diff.py),
+        then arbitrates each divergent key by **per-key LWW** over the
+        deterministic order ``(ts, liveness, digest)``: newest timestamp
+        wins; at equal timestamps a live value beats a tombstone; live ties
+        break toward the lexicographically larger digest. Only the winning
+        values are fetched — grouped per peer so each value travels once —
+        and installed conditionally (set_if_newer) WITH the winner's
+        timestamp so ordering metadata propagates and racing local writes
+        survive. A winning tombstone deletes locally (delete_if_newer), so
+        a deletion whose replication event was dropped still converges
+        cluster-wide instead of being resurrected by peers holding the old
+        value. BARE absence (no value, no tombstone) still never wins: a
+        fresh write seen by one node is never destroyed by peers that
+        merely haven't received it yet. Every node running this same
+        deterministic rule converges the cluster to the LWW-merged union
+        keyspace. Timestamps are wall clocks — cross-node skew trades
+        accuracy for availability exactly like the reference's replication
+        LWW (replication.rs:289-290).
 
         The reference has no analog: its sync is strictly pairwise and
-        full-transfer (/root/reference/src/sync.rs:56-87).
+        full-transfer, and a deletion it hasn't replicated is undone
+        forever (/root/reference/src/sync.rs:56-87,74-83).
         """
         with span("anti_entropy.sync_multi", peers=",".join(peers)) as rec:
             report = self._sync_multi(peers)
@@ -326,20 +380,52 @@ class SyncManager:
 
         # Gather peer leaf-hash+ts maps; a down peer is skipped this cycle.
         clients: list[Optional[MerkleKVClient]] = []
-        peer_hashes: list[dict[bytes, tuple[bytes, int]]] = []
+        peer_hashes: list[dict[bytes, tuple[Optional[bytes], int]]] = []
+
+        def drop_peer(c: Optional[MerkleKVClient], why: str) -> None:
+            # Every early-exit path must release the socket: this loop runs
+            # every anti-entropy cycle, and an unclosed client per cycle is
+            # a steady fd leak.
+            if c is not None:
+                c.close()
+            report.details.append(why)
+            clients.append(None)
+            peer_hashes.append({})
+
         for peer in peers:
             host, _, port = peer.rpartition(":")
+            c: Optional[MerkleKVClient] = None
             try:
                 c = MerkleKVClient(host, int(port), timeout=self._timeout)
                 c.connect()
-                raw = c.leaf_hashes_ts()
             except Exception as e:
-                report.details.append(f"{peer}: unreachable ({e!r})")
-                clients.append(None)
-                peer_hashes.append({})
+                drop_peer(c, f"{peer}: unreachable ({e!r})")
                 continue
+            try:
+                decoded = _decode_leaf_map(c.leaf_hashes_ts())
+            except Exception:
+                # Peer serves data but not LEAFHASHES (the pairwise path's
+                # full-transfer fallback, here too): fetch its snapshot and
+                # hash locally. Entries carry ts 0 ("unknown age"), so the
+                # peer contributes missing keys to the union but loses
+                # every LWW race — it can never overwrite fresher state.
+                get_metrics().inc("anti_entropy.leafhash_fallbacks")
+                try:
+                    remote = self._fetch_remote(c)
+                    decoded = {
+                        k: (d, 0)
+                        for k, d in _leaf_map(
+                            sorted(remote.items()), False
+                        ).items()
+                    }
+                    report.details.append(
+                        f"{peer}: LEAFHASHES unsupported; full snapshot"
+                    )
+                except Exception as e:
+                    drop_peer(c, f"{peer}: unreachable ({e!r})")
+                    continue
             clients.append(c)
-            peer_hashes.append(_decode_leaf_map(raw))
+            peer_hashes.append(decoded)
         live = [i for i, c in enumerate(clients) if c is not None]
         try:
             if not live:
@@ -353,9 +439,20 @@ class SyncManager:
             local_hashes = _leaf_map(sorted(local.items()), use_device)
 
             # Replica 0 = local; only live peers join the arbitration.
+            # Each peer's payload splits into live digests (alignment input)
+            # and tombstones (deletion candidates for the LWW round).
             peer_maps = [peer_hashes[i] for i in live]
+            peer_live = [
+                {k: (d, ts) for k, (d, ts) in pm.items() if d is not None}
+                for pm in peer_maps
+            ]
+            peer_tombs = [
+                {k: ts for k, (d, ts) in pm.items() if d is None}
+                for pm in peer_maps
+            ]
+            local_tombs = dict(self._engine.tombstones())
             replicas = [local_hashes] + [
-                {k: d for k, (d, _) in pm.items()} for pm in peer_maps
+                {k: d for k, (d, _) in pl.items()} for pl in peer_live
             ]
             aligned = align_replicas(replicas)
             report.union_keys = aligned.n_keys
@@ -391,27 +488,45 @@ class SyncManager:
                 off = (r * n_div + j) * 32
                 return raw_digests[off : off + 32]
 
-            # Per-key LWW among replicas HOLDING the key (absence never
-            # wins — see docstring): newest ts, then larger digest.
+            # Per-key LWW among replicas holding the key OR a tombstone for
+            # it (bare absence never wins — see docstring). Candidate order
+            # is (ts, liveness, digest): liveness 1 for a value, 0 for a
+            # tombstone, so a value wins timestamp ties — matching the
+            # engine's set_if_newer/del_if_newer tie rule.
             # wants[peer_slot] = (key, winner_ts) pairs that peer serves.
             wants: dict[int, list[tuple[bytes, int]]] = {}
             for j, i in enumerate(divergent):
                 key = aligned.keys[i]
-                best: Optional[tuple[int, bytes]] = None
+                best: Optional[tuple[int, int, bytes]] = None
                 for slot in range(len(replicas)):
-                    if not aligned.present[slot, i]:
-                        continue
-                    if slot == 0:
-                        ts = self._engine.get_ts(key) or 0
+                    if aligned.present[slot, i]:
+                        if slot == 0:
+                            ts = self._engine.get_ts(key) or 0
+                        else:
+                            ts = peer_live[slot - 1][key][1]
+                        cand = (ts, 1, dig(slot, j))
                     else:
-                        ts = peer_maps[slot - 1][key][1]
-                    cand = (ts, dig(slot, j))
+                        tomb = (
+                            local_tombs.get(key)
+                            if slot == 0
+                            else peer_tombs[slot - 1].get(key)
+                        )
+                        if tomb is None:
+                            continue
+                        cand = (tomb, 0, b"")
                     if best is None or cand > best:
                         best = cand
                 if best is None:
                     continue
-                winner_ts, winner = best
-                local_d = dig(0, j) if aligned.present[0, i] else None
+                winner_ts, winner_live, winner = best
+                local_present = bool(aligned.present[0, i])
+                if not winner_live:
+                    # A deletion won: apply it locally unless local state is
+                    # newer (delete_if_newer re-checks under the shard lock).
+                    if self._repair_delete_lww(key, winner_ts, local_present):
+                        report.deleted_keys += 1
+                    continue
+                local_d = dig(0, j) if local_present else None
                 if winner == local_d:
                     continue  # local already holds the winning state
                 for slot, r in enumerate(live, start=1):
@@ -424,8 +539,8 @@ class SyncManager:
                 report.values_fetched += len(values)
                 for k, ts in pairs:
                     if k in values:
-                        self._repair_set(k, values[k], ts)
-                        report.set_keys += 1
+                        if self._repair_set_lww(k, values[k], ts):
+                            report.set_keys += 1
         finally:
             for c in clients:
                 if c is not None:

@@ -93,8 +93,82 @@ int mkv_engine_get_ts(void* h, const char* key, int klen,
   return 1;
 }
 
+// Atomic (value, last-write ts) read: returns 1 if present with out/out_len
+// (free with mkv_free) and *out_ts filled, else 0.
+int mkv_engine_get_with_ts(void* h, const char* key, int klen, char** out,
+                           int* out_len, unsigned long long* out_ts) {
+  auto vt =
+      static_cast<Engine*>(h)->get_with_ts(std::string(key, size_t(klen)));
+  if (!vt) return 0;
+  *out = dup_buffer(vt->first);
+  *out_len = int(vt->first.size());
+  *out_ts = vt->second;
+  return 1;
+}
+
 int mkv_engine_del(void* h, const char* key, int klen) {
   return static_cast<Engine*>(h)->del(std::string(key, size_t(klen))) ? 1 : 0;
+}
+
+int mkv_engine_del_with_ts(void* h, const char* key, int klen,
+                           unsigned long long ts) {
+  return static_cast<Engine*>(h)->del_with_ts(std::string(key, size_t(klen)),
+                                              uint64_t(ts))
+             ? 1
+             : 0;
+}
+
+int mkv_engine_del_quiet(void* h, const char* key, int klen) {
+  return static_cast<Engine*>(h)->del_quiet(std::string(key, size_t(klen)))
+             ? 1
+             : 0;
+}
+
+// LWW-conditional install/delete; returns 1 if the op applied.
+int mkv_engine_set_if_newer(void* h, const char* key, int klen,
+                            const char* val, int vlen,
+                            unsigned long long ts) {
+  return static_cast<Engine*>(h)->set_if_newer(std::string(key, size_t(klen)),
+                                               std::string(val, size_t(vlen)),
+                                               uint64_t(ts))
+             ? 1
+             : 0;
+}
+
+int mkv_engine_del_if_newer(void* h, const char* key, int klen,
+                            unsigned long long ts) {
+  return static_cast<Engine*>(h)->del_if_newer(std::string(key, size_t(klen)),
+                                               uint64_t(ts))
+             ? 1
+             : 0;
+}
+
+// Returns 1 and fills *out_ts with the key's tombstone timestamp, else 0.
+int mkv_engine_tombstone_ts(void* h, const char* key, int klen,
+                            unsigned long long* out_ts) {
+  auto ts =
+      static_cast<Engine*>(h)->tombstone_ts(std::string(key, size_t(klen)));
+  if (!ts) return 0;
+  *out_ts = *ts;
+  return 1;
+}
+
+// tombstones: u32 count, then per item u32 klen + key + u64 delete-ts,
+// sorted by key. Free with mkv_free.
+int mkv_engine_tombstones(void* h, const char* prefix, int plen, char** out,
+                          int* out_len) {
+  auto tombs =
+      static_cast<Engine*>(h)->tombstones(std::string(prefix, size_t(plen)));
+  std::string buf;
+  put_u32(buf, uint32_t(tombs.size()));
+  for (const auto& [k, ts] : tombs) {
+    put_u32(buf, uint32_t(k.size()));
+    buf += k;
+    put_u64(buf, ts);
+  }
+  *out = dup_buffer(buf);
+  *out_len = int(buf.size());
+  return 1;
 }
 
 int mkv_engine_exists(void* h, const char* key, int klen) {

@@ -76,6 +76,9 @@ bool MemEngine::set_with_ts(const std::string& key, const std::string& value,
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
   s.map[key] = Entry{value, ts};
+  // A present value supersedes any deletion record: without this a key
+  // would be advertised live AND tombstoned to peers at once.
+  s.tombs.erase(key);
   return true;
 }
 
@@ -87,10 +90,109 @@ std::optional<uint64_t> MemEngine::get_ts(const std::string& key) {
   return it->second.ts;
 }
 
+std::optional<std::pair<std::string, uint64_t>> MemEngine::get_with_ts(
+    const std::string& key) {
+  Shard& s = shard_for(key);
+  std::shared_lock lk(s.mu);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return std::nullopt;
+  return std::make_pair(it->second.value, it->second.ts);
+}
+
+void MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
+  // Caller holds the shard's unique lock.
+  auto [it, inserted] = s.tombs.try_emplace(key, ts);
+  if (!inserted && it->second < ts) it->second = ts;
+  if (s.tombs.size() > kMaxTombsPerShard) {
+    // Amortized eviction: one scan drops the oldest ~1/8 of the map, so a
+    // delete-heavy workload at the cap pays the scan once per ~8k deletes
+    // instead of on every delete (the scan holds the shard's write lock).
+    std::vector<uint64_t> tss;
+    tss.reserve(s.tombs.size());
+    for (const auto& [k, t] : s.tombs) {
+      (void)k;
+      tss.push_back(t);
+    }
+    auto cut = tss.begin() + ptrdiff_t(tss.size() / 8);
+    std::nth_element(tss.begin(), cut, tss.end());
+    const uint64_t cutoff = *cut;
+    size_t evicted = 0;
+    const size_t target = tss.size() / 8;
+    for (auto i = s.tombs.begin(); i != s.tombs.end() && evicted < target;) {
+      if (i->second <= cutoff) {
+        i = s.tombs.erase(i);
+        ++evicted;
+      } else {
+        ++i;
+      }
+    }
+  }
+}
+
 bool MemEngine::del(const std::string& key) {
+  return del_with_ts(key, now_ns());
+}
+
+bool MemEngine::del_with_ts(const std::string& key, uint64_t ts) {
+  Shard& s = shard_for(key);
+  std::unique_lock lk(s.mu);
+  bool existed = s.map.erase(key) > 0;
+  note_tomb(s, key, ts);
+  return existed;
+}
+
+bool MemEngine::del_quiet(const std::string& key) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
   return s.map.erase(key) > 0;
+}
+
+bool MemEngine::set_if_newer(const std::string& key, const std::string& value,
+                             uint64_t ts) {
+  Shard& s = shard_for(key);
+  std::unique_lock lk(s.mu);
+  auto it = s.map.find(key);
+  if (it != s.map.end() && ts < it->second.ts) return false;
+  auto tt = s.tombs.find(key);
+  if (tt != s.tombs.end() && ts < tt->second) return false;  // tie: value wins
+  s.map[key] = Entry{value, ts};
+  if (tt != s.tombs.end()) s.tombs.erase(tt);
+  return true;
+}
+
+bool MemEngine::del_if_newer(const std::string& key, uint64_t ts) {
+  Shard& s = shard_for(key);
+  std::unique_lock lk(s.mu);
+  auto it = s.map.find(key);
+  if (it != s.map.end()) {
+    if (ts <= it->second.ts) return false;  // tie: value wins
+    s.map.erase(it);
+  }
+  // Absent key: still record the tombstone — it blocks older writes from
+  // resurrecting later (applied in the "state now matches" sense).
+  note_tomb(s, key, ts);
+  return true;
+}
+
+std::optional<uint64_t> MemEngine::tombstone_ts(const std::string& key) {
+  Shard& s = shard_for(key);
+  std::shared_lock lk(s.mu);
+  auto it = s.tombs.find(key);
+  if (it == s.tombs.end()) return std::nullopt;
+  return it->second;
+}
+
+std::vector<std::pair<std::string, uint64_t>> MemEngine::tombstones(
+    const std::string& prefix) {
+  std::vector<std::pair<std::string, uint64_t>> out;
+  for (Shard& s : shards_) {
+    std::shared_lock lk(s.mu);
+    for (const auto& [k, ts] : s.tombs) {
+      if (k.compare(0, prefix.size(), prefix) == 0) out.emplace_back(k, ts);
+    }
+  }
+  std::sort(out.begin(), out.end());
+  return out;
 }
 
 bool MemEngine::exists(const std::string& key) {
@@ -183,6 +285,9 @@ bool MemEngine::truncate() {
   for (Shard& s : shards_) {
     std::unique_lock lk(s.mu);
     s.map.clear();
+    // TRUNCATE is a local admin wipe, not a per-key deletion: it stays
+    // local (never replicated) and drops deletion history with the data.
+    s.tombs.clear();
   }
   return true;
 }
@@ -213,6 +318,9 @@ constexpr uint8_t kOpSet = 1;
 constexpr uint8_t kOpDel = 2;
 constexpr uint8_t kOpTruncate = 3;
 constexpr uint8_t kOpSetTs = 4;
+// DEL carrying its tombstone timestamp, so deletion LWW ordering survives
+// restart the same way kOpSetTs preserves write ordering.
+constexpr uint8_t kOpDelTs = 5;
 
 bool read_exact(int fd, void* buf, size_t len) {
   uint8_t* p = static_cast<uint8_t*>(buf);
@@ -259,7 +367,7 @@ LogEngine::LogEngine(const std::string& dir) {
           !read_exact(rfd, &vlen, 4)) {
         break;
       }
-      const off_t ts_size = (op == kOpSetTs) ? 8 : 0;
+      const off_t ts_size = (op == kOpSetTs || op == kOpDelTs) ? 8 : 0;
       const off_t rec_size = off_t(9) + ts_size + klen + vlen;
       // Torn-tail test by exact arithmetic, not a size cap: a record whose
       // claimed payload runs past the end of the file cannot be complete
@@ -273,8 +381,11 @@ LogEngine::LogEngine(const std::string& dir) {
       if (vlen && !read_exact(rfd, value.data(), vlen)) break;
       if (op == kOpSet || op == kOpSetTs) {
         mem_.set_with_ts(key, value, ts);
+      } else if (op == kOpDelTs) {
+        mem_.del_with_ts(key, ts);
       } else if (op == kOpDel) {
-        mem_.del(key);
+        // Quiet/legacy deletes carry no deletion intent to preserve.
+        mem_.del_quiet(key);
       } else if (op == kOpTruncate) {
         mem_.truncate();
       } else {
@@ -301,7 +412,7 @@ bool LogEngine::append_record(uint8_t op, const std::string& key,
                               const std::string& value, uint64_t ts) {
   if (fd_ < 0) return false;
   std::string rec;
-  const bool with_ts = op == kOpSetTs;
+  const bool with_ts = op == kOpSetTs || op == kOpDelTs;
   rec.reserve(9 + (with_ts ? 8 : 0) + key.size() + value.size());
   rec.push_back(char(op));
   uint32_t klen = uint32_t(key.size()), vlen = uint32_t(value.size());
@@ -333,11 +444,53 @@ std::optional<uint64_t> LogEngine::get_ts(const std::string& key) {
   return mem_.get_ts(key);
 }
 
+std::optional<std::pair<std::string, uint64_t>> LogEngine::get_with_ts(
+    const std::string& key) {
+  return mem_.get_with_ts(key);
+}
+
 bool LogEngine::del(const std::string& key) {
+  return del_with_ts(key, now_ns());
+}
+
+bool LogEngine::del_with_ts(const std::string& key, uint64_t ts) {
   std::unique_lock lk(log_mu_);
-  bool existed = mem_.del(key);
+  bool existed = mem_.del_with_ts(key, ts);
+  // Logged even when the key is absent: the tombstone itself is state
+  // (it must keep blocking older writes after a restart).
+  append_record(kOpDelTs, key, "", ts);
+  return existed;
+}
+
+bool LogEngine::del_quiet(const std::string& key) {
+  std::unique_lock lk(log_mu_);
+  bool existed = mem_.del_quiet(key);
   if (existed) append_record(kOpDel, key, "", 0);
   return existed;
+}
+
+bool LogEngine::set_if_newer(const std::string& key, const std::string& value,
+                             uint64_t ts) {
+  std::unique_lock lk(log_mu_);
+  if (!mem_.set_if_newer(key, value, ts)) return false;
+  append_record(kOpSetTs, key, value, ts);
+  return true;
+}
+
+bool LogEngine::del_if_newer(const std::string& key, uint64_t ts) {
+  std::unique_lock lk(log_mu_);
+  if (!mem_.del_if_newer(key, ts)) return false;
+  append_record(kOpDelTs, key, "", ts);
+  return true;
+}
+
+std::optional<uint64_t> LogEngine::tombstone_ts(const std::string& key) {
+  return mem_.tombstone_ts(key);
+}
+
+std::vector<std::pair<std::string, uint64_t>> LogEngine::tombstones(
+    const std::string& prefix) {
+  return mem_.tombstones(prefix);
 }
 
 bool LogEngine::exists(const std::string& key) { return mem_.exists(key); }
@@ -409,21 +562,39 @@ bool LogEngine::compact() {
   std::string tmp = path_ + ".compact";
   int nfd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
   if (nfd < 0) return false;
-  for (const auto& [k, v] : snap) {
+  auto emit = [&](uint8_t op, const std::string& k, const std::string& v,
+                  uint64_t ts) {
     std::string rec;
-    rec.push_back(char(kOpSetTs));
+    rec.push_back(char(op));
     uint32_t klen = uint32_t(k.size()), vlen = uint32_t(v.size());
-    uint64_t ts = mem_.get_ts(k).value_or(0);
     rec.append(reinterpret_cast<const char*>(&klen), 4);
     rec.append(reinterpret_cast<const char*>(&vlen), 4);
     rec.append(reinterpret_cast<const char*>(&ts), 8);
     rec.append(k);
     rec.append(v);
-    if (!write_all(nfd, rec.data(), rec.size())) {
-      ::close(nfd);
-      ::unlink(tmp.c_str());
-      return false;
+    return write_all(nfd, rec.data(), rec.size());
+  };
+  bool ok = true;
+  for (const auto& [k, v] : snap) {
+    if (!emit(kOpSetTs, k, v, mem_.get_ts(k).value_or(0))) {
+      ok = false;
+      break;
     }
+  }
+  // Tombstones are state too: dropping them here would let older writes
+  // resurrect deleted keys after a compaction + restart.
+  if (ok) {
+    for (const auto& [k, ts] : mem_.tombstones("")) {
+      if (!emit(kOpDelTs, k, "", ts)) {
+        ok = false;
+        break;
+      }
+    }
+  }
+  if (!ok) {
+    ::close(nfd);
+    ::unlink(tmp.c_str());
+    return false;
   }
   ::fsync(nfd);
   ::close(nfd);

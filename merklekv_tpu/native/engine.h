@@ -46,7 +46,40 @@ class Engine {
   // Last-write timestamp (unix ns) of a present key; nullopt if absent.
   // Plain writes stamp the wall clock; replayed legacy log records carry 0.
   virtual std::optional<uint64_t> get_ts(const std::string& key) = 0;
-  virtual bool del(const std::string& key) = 0;  // true if the key existed
+  // Value AND its last-write ts under ONE shard lock. LEAFHASHES pairs a
+  // digest with a ts for peers' LWW arbitration; reading them separately
+  // can pair a stale value with a newer timestamp across a racing write.
+  virtual std::optional<std::pair<std::string, uint64_t>> get_with_ts(
+      const std::string& key) = 0;
+  // User-intent deletion: removes the entry AND records a tombstone stamped
+  // "now" so the deletion participates in LWW against concurrent writes
+  // elsewhere in the cluster. The reference has no tombstones — a dropped
+  // DEL event there is undone forever by any peer still holding the value
+  // (sync.rs:74-83 resurrects it). True if the key existed.
+  virtual bool del(const std::string& key) = 0;
+  // Deletion carrying an explicit tombstone timestamp (replication apply,
+  // tombstone adoption from a peer).
+  virtual bool del_with_ts(const std::string& key, uint64_t ts) = 0;
+  // Mirror deletion: removes the entry WITHOUT a tombstone. Pairwise
+  // anti-entropy ("make local equal that peer", reference sync.rs:74-83)
+  // deletes local-only keys as a *copy* operation — fabricating a
+  // deletion-at-now there would later kill disjoint writes cluster-wide
+  // through multi-peer LWW.
+  virtual bool del_quiet(const std::string& key) = 0;
+  // LWW-conditional ops, atomic per shard: apply only if ts is not older
+  // than both the live entry's ts and any tombstone's ts. A VALUE WINS
+  // TIES over a tombstone (set_if_newer applies at ts == tomb ts;
+  // del_if_newer requires ts strictly newer than the entry) — matching the
+  // sync arbitration's deterministic (ts, liveness, digest) order. Return
+  // whether the op applied.
+  virtual bool set_if_newer(const std::string& key, const std::string& value,
+                            uint64_t ts) = 0;
+  virtual bool del_if_newer(const std::string& key, uint64_t ts) = 0;
+  // Tombstone timestamp for a deleted key, if one is recorded.
+  virtual std::optional<uint64_t> tombstone_ts(const std::string& key) = 0;
+  // Sorted (key, delete-ts) tombstones with the given prefix ("" = all).
+  virtual std::vector<std::pair<std::string, uint64_t>> tombstones(
+      const std::string& prefix) = 0;
   virtual bool exists(const std::string& key) = 0;
   // Sorted keys with the given prefix ("" = all).
   virtual std::vector<std::string> scan(const std::string& prefix) = 0;
@@ -77,7 +110,17 @@ class MemEngine : public Engine {
   bool set_with_ts(const std::string& key, const std::string& value,
                    uint64_t ts) override;
   std::optional<uint64_t> get_ts(const std::string& key) override;
+  std::optional<std::pair<std::string, uint64_t>> get_with_ts(
+      const std::string& key) override;
   bool del(const std::string& key) override;
+  bool del_with_ts(const std::string& key, uint64_t ts) override;
+  bool del_quiet(const std::string& key) override;
+  bool set_if_newer(const std::string& key, const std::string& value,
+                    uint64_t ts) override;
+  bool del_if_newer(const std::string& key, uint64_t ts) override;
+  std::optional<uint64_t> tombstone_ts(const std::string& key) override;
+  std::vector<std::pair<std::string, uint64_t>> tombstones(
+      const std::string& prefix) override;
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
   size_t dbsize() override;
@@ -100,7 +143,13 @@ class MemEngine : public Engine {
   struct Shard {
     mutable std::shared_mutex mu;
     std::unordered_map<std::string, Entry> map;
+    // key -> deletion ts. Bounded (kMaxTombsPerShard): the oldest tombstone
+    // is evicted on overflow — an evicted tombstone degrades to the
+    // reference's no-tombstone behavior for that key, never worse.
+    std::unordered_map<std::string, uint64_t> tombs;
   };
+  static constexpr size_t kMaxTombsPerShard = 1 << 16;
+  static void note_tomb(Shard& s, const std::string& key, uint64_t ts);
   Shard& shard_for(const std::string& key);
   Result<int64_t> add(const std::string& key, int64_t delta);
   Result<std::string> splice(const std::string& key, const std::string& value,
@@ -124,7 +173,17 @@ class LogEngine : public Engine {
   bool set_with_ts(const std::string& key, const std::string& value,
                    uint64_t ts) override;
   std::optional<uint64_t> get_ts(const std::string& key) override;
+  std::optional<std::pair<std::string, uint64_t>> get_with_ts(
+      const std::string& key) override;
   bool del(const std::string& key) override;
+  bool del_with_ts(const std::string& key, uint64_t ts) override;
+  bool del_quiet(const std::string& key) override;
+  bool set_if_newer(const std::string& key, const std::string& value,
+                    uint64_t ts) override;
+  bool del_if_newer(const std::string& key, uint64_t ts) override;
+  std::optional<uint64_t> tombstone_ts(const std::string& key) override;
+  std::vector<std::pair<std::string, uint64_t>> tombstones(
+      const std::string& prefix) override;
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
   size_t dbsize() override;

@@ -386,25 +386,33 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
     }
     case Verb::LeafHashes: {
       auto keys = engine_->scan(cmd.prefix);
-      std::string out = "HASHES " + std::to_string(keys.size()) + "\r\n";
+      std::string body;
       size_t listed = 0;
       for (const auto& k : keys) {
-        auto v = engine_->get(k);
-        if (!v) continue;  // deleted between scan and get
+        // One atomic (value, ts) read per key: a separate get + get_ts pair
+        // can interleave with a write and ship a stale digest stamped with
+        // the new write's timestamp — which peers' LWW would then treat as
+        // the newest state.
+        auto vt = engine_->get_with_ts(k);
+        if (!vt) continue;  // deleted between scan and read
         uint8_t d[32];
-        leaf_hash(k, *v, d);
+        leaf_hash(k, vt->first, d);
         // Trailing last-write timestamp (unix ns) feeds the peer's LWW
-        // arbitration; older readers that split on the last space still
-        // parse key+digest correctly.
-        out += k + " " + digest_hex(d) + " " +
-               std::to_string(engine_->get_ts(k).value_or(0)) + "\r\n";
+        // arbitration.
+        body += k + " " + digest_hex(d) + " " + std::to_string(vt->second) +
+                "\r\n";
         ++listed;
       }
-      if (listed != keys.size()) {
-        out = "HASHES " + std::to_string(listed) +
-              out.substr(out.find("\r\n"));
+      // Tombstones ride along with digest "-": a peer's multi-replica LWW
+      // needs deletion timestamps, or a dropped DEL event is undone forever
+      // by any replica still holding the value. A reader that can't parse
+      // "-" treats the whole payload as undecodable and degrades to the
+      // full-snapshot fallback (sync.py _fetch_remote_hashes).
+      for (const auto& [k, ts] : engine_->tombstones(cmd.prefix)) {
+        body += k + " - " + std::to_string(ts) + "\r\n";
+        ++listed;
       }
-      return out;
+      return "HASHES " + std::to_string(listed) + "\r\n" + body;
     }
     case Verb::Truncate:
     case Verb::Flushdb: {

@@ -92,7 +92,24 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
         P(ctypes.c_ulonglong),
     ]
+    lib.mkv_engine_get_with_ts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        P(ctypes.c_void_p), P(ctypes.c_int), P(ctypes.c_ulonglong),
+    ]
     lib.mkv_engine_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.mkv_engine_del_with_ts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_ulonglong,
+    ]
+    lib.mkv_engine_del_quiet.argtypes = lib.mkv_engine_del.argtypes
+    lib.mkv_engine_set_if_newer.argtypes = lib.mkv_engine_set_with_ts.argtypes
+    lib.mkv_engine_del_if_newer.argtypes = lib.mkv_engine_del_with_ts.argtypes
+    lib.mkv_engine_tombstone_ts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, P(ctypes.c_ulonglong),
+    ]
+    lib.mkv_engine_tombstones.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        P(ctypes.c_void_p), P(ctypes.c_int),
+    ]
     lib.mkv_engine_exists.argtypes = lib.mkv_engine_del.argtypes
     lib.mkv_engine_dbsize.restype = ctypes.c_longlong
     lib.mkv_engine_dbsize.argtypes = [ctypes.c_void_p]
@@ -210,8 +227,78 @@ class NativeEngine:
             return None
         return int(ts.value)
 
+    def get_with_ts(self, key: bytes) -> Optional[tuple[bytes, int]]:
+        """(value, last-write ts) read under ONE shard lock — the pairing a
+        LWW consumer needs (a separate get + get_ts can interleave with a
+        racing write)."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int()
+        ts = ctypes.c_ulonglong()
+        if not self._lib.mkv_engine_get_with_ts(
+            self._h, key, len(key),
+            ctypes.byref(out), ctypes.byref(out_len), ctypes.byref(ts),
+        ):
+            return None
+        return _take_buffer(self._lib, out, out_len.value), int(ts.value)
+
     def delete(self, key: bytes) -> bool:
+        """User-intent delete: records a tombstone stamped now, so the
+        deletion participates in cluster LWW."""
         return bool(self._lib.mkv_engine_del(self._h, key, len(key)))
+
+    def delete_with_ts(self, key: bytes, ts: int) -> bool:
+        """Delete with an explicit tombstone timestamp (replication apply,
+        tombstone adoption from a peer)."""
+        return bool(self._lib.mkv_engine_del_with_ts(self._h, key, len(key), ts))
+
+    def delete_quiet(self, key: bytes) -> bool:
+        """Mirror delete — NO tombstone. Pairwise anti-entropy copies a
+        peer's absence; recording that as a deletion-at-now would later kill
+        disjoint writes cluster-wide through multi-peer LWW."""
+        return bool(self._lib.mkv_engine_del_quiet(self._h, key, len(key)))
+
+    def set_if_newer(self, key: bytes, value: bytes, ts: int) -> bool:
+        """Install iff ts is not older than the entry AND any tombstone
+        (value wins timestamp ties). Returns whether it applied."""
+        return bool(
+            self._lib.mkv_engine_set_if_newer(
+                self._h, key, len(key), value, len(value), ts
+            )
+        )
+
+    def delete_if_newer(self, key: bytes, ts: int) -> bool:
+        """Delete iff ts is strictly newer than the live entry; records the
+        tombstone. Returns whether it applied."""
+        return bool(self._lib.mkv_engine_del_if_newer(self._h, key, len(key), ts))
+
+    def tombstone_ts(self, key: bytes) -> Optional[int]:
+        ts = ctypes.c_ulonglong()
+        if not self._lib.mkv_engine_tombstone_ts(
+            self._h, key, len(key), ctypes.byref(ts)
+        ):
+            return None
+        return int(ts.value)
+
+    def tombstones(self, prefix: bytes = b"") -> list[tuple[bytes, int]]:
+        """Sorted (key, delete-ts) tombstones — the deletion half of the
+        anti-entropy exchange."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int()
+        self._lib.mkv_engine_tombstones(
+            self._h, prefix, len(prefix), ctypes.byref(out), ctypes.byref(out_len)
+        )
+        buf = _take_buffer(self._lib, out, out_len.value)
+        (n,) = struct.unpack_from("<I", buf, 0)
+        items, off = [], 4
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            k = buf[off : off + klen]
+            off += klen
+            (ts,) = struct.unpack_from("<Q", buf, off)
+            off += 8
+            items.append((k, ts))
+        return items
 
     def exists(self, key: bytes) -> bool:
         return bool(self._lib.mkv_engine_exists(self._h, key, len(key)))
@@ -229,8 +316,9 @@ class NativeEngine:
         self._lib.mkv_engine_sync(self._h)
 
     def compact(self) -> bool:
-        """Rewrite the durable log as a live-state snapshot (drops
-        tombstones). False for engines without a log."""
+        """Rewrite the durable log as a snapshot of live state plus
+        tombstones (deletion LWW knowledge survives compaction). False for
+        engines without a log."""
         return bool(self._lib.mkv_engine_compact(self._h))
 
     def increment(self, key: bytes, amount: int = 1) -> int:

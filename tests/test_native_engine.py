@@ -185,3 +185,100 @@ def test_log_engine_truncate_persists():
         with NativeEngine("log", d) as e2:
             assert e2.get(b"a") is None
             assert e2.get(b"b") == b"2"
+
+
+# ------------------------------------------------------- tombstones & LWW
+
+
+def test_get_with_ts_atomic_pair(eng):
+    eng.set_with_ts(b"k", b"v", 123)
+    assert eng.get_with_ts(b"k") == (b"v", 123)
+    assert eng.get_with_ts(b"missing") is None
+
+
+def test_delete_records_tombstone(eng):
+    eng.set(b"k", b"v")
+    assert eng.delete(b"k")
+    ts = eng.tombstone_ts(b"k")
+    assert ts is not None and ts > 0
+    assert eng.tombstones() == [(b"k", ts)]
+
+
+def test_delete_quiet_records_no_tombstone(eng):
+    """Mirror deletes (pairwise anti-entropy) must not fabricate deletion
+    intent — a tombstone-at-now would kill disjoint writes cluster-wide."""
+    eng.set(b"k", b"v")
+    assert eng.delete_quiet(b"k")
+    assert eng.tombstone_ts(b"k") is None
+
+
+def test_set_clears_tombstone(eng):
+    eng.set(b"k", b"v")
+    eng.delete(b"k")
+    eng.set(b"k", b"v2")
+    assert eng.tombstone_ts(b"k") is None
+    assert eng.get(b"k") == b"v2"
+
+
+def test_set_if_newer_respects_entry_and_tombstone(eng):
+    eng.set_with_ts(b"k", b"v", 100)
+    assert not eng.set_if_newer(b"k", b"older", 99)
+    assert eng.get(b"k") == b"v"
+    assert eng.set_if_newer(b"k", b"tie", 100)  # tie installs (caller broke it)
+    assert eng.set_if_newer(b"k", b"newer", 101)
+    eng.delete_with_ts(b"k", 200)
+    assert not eng.set_if_newer(b"k", b"stale", 199)  # older than tombstone
+    assert eng.get(b"k") is None
+    assert eng.set_if_newer(b"k", b"fresh", 200)  # value wins the ts tie
+    assert eng.get(b"k") == b"fresh"
+    assert eng.tombstone_ts(b"k") is None
+
+
+def test_del_if_newer_value_wins_ties(eng):
+    eng.set_with_ts(b"k", b"v", 100)
+    assert not eng.delete_if_newer(b"k", 100)  # tie: value survives
+    assert eng.get(b"k") == b"v"
+    assert eng.delete_if_newer(b"k", 101)
+    assert eng.get(b"k") is None
+    assert eng.tombstone_ts(b"k") == 101
+    # Advancing an absent key's tombstone still applies (blocks older sets).
+    assert eng.delete_if_newer(b"other", 50)
+    assert not eng.set_if_newer(b"other", b"old", 49)
+
+
+def test_tombstones_prefix_filter(eng):
+    eng.set(b"a1", b"x")
+    eng.set(b"b1", b"x")
+    eng.delete(b"a1")
+    eng.delete(b"b1")
+    tombs = eng.tombstones(b"a")
+    assert [k for k, _ in tombs] == [b"a1"]
+
+
+def test_log_engine_tombstone_survives_restart():
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.set(b"k", b"v")
+            e.delete(b"k")
+            ts = e.tombstone_ts(b"k")
+            e.sync()
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"k") is None
+            assert e2.tombstone_ts(b"k") == ts
+            # The persisted tombstone still arbitrates LWW after restart.
+            assert not e2.set_if_newer(b"k", b"stale", ts - 1)
+            assert e2.get(b"k") is None
+
+
+def test_log_engine_tombstone_survives_compaction():
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.set(b"live", b"v")
+            e.set(b"dead", b"v")
+            e.delete(b"dead")
+            ts = e.tombstone_ts(b"dead")
+            assert e.compact()
+            e.sync()
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"live") == b"v"
+            assert e2.tombstone_ts(b"dead") == ts

@@ -332,3 +332,126 @@ def test_periodic_loop_repairs(two_nodes):
         assert local_eng.get(b"auto") == b"repaired"
     finally:
         mgr.stop()
+
+
+# ---------------------------------------------------- tombstones & deletions
+
+
+def test_leafhashes_carries_tombstones(two_nodes):
+    """Wire format: deleted keys ride along as 'key - <ts>' lines."""
+    (_, _), (remote_eng, remote_srv) = two_nodes
+    remote_eng.set(b"live", b"v")
+    remote_eng.set(b"dead", b"v")
+    remote_eng.delete(b"dead")
+    with MerkleKVClient("127.0.0.1", remote_srv.port) as c:
+        hashes = c.leaf_hashes_ts()
+    assert hashes["live"][0] is not None
+    assert hashes["dead"][0] is None  # tombstone marker
+    assert hashes["dead"][1] == remote_eng.tombstone_ts(b"dead")
+    # leaf_hashes() (live view) filters tombstones out.
+    with MerkleKVClient("127.0.0.1", remote_srv.port) as c:
+        assert set(c.leaf_hashes()) == {"live"}
+
+
+def test_dropped_delete_survives_multi_peer_sync(three_nodes):
+    """THE tombstone scenario (reference can't do this — sync.rs:74-83
+    resurrects any deletion a peer hasn't heard about): node A deletes a
+    key but the DEL replication event is lost; multi-peer anti-entropy
+    still converges the cluster to 'deleted', not back to the old value."""
+    (a_eng, a_srv), (b_eng, b_srv), (c_eng, c_srv) = three_nodes
+    engines = [a_eng, b_eng, c_eng]
+    servers = [a_srv, b_srv, c_srv]
+    base = {f"tk{i:02d}": f"v{i}" for i in range(20)}
+    for e in engines:
+        fill(e, base)
+    time.sleep(0.002)  # ensure the deletion ts is strictly newer
+    a_eng.delete(b"tk05")  # DEL event "dropped": B and C never hear of it
+
+    for _ in range(3):
+        for me in range(3):
+            peers = [
+                f"127.0.0.1:{servers[j].port}" for j in range(3) if j != me
+            ]
+            SyncManager(engines[me], device="cpu").sync_multi(peers)
+
+    for e in engines:
+        assert e.get(b"tk05") is None, "deletion was resurrected"
+        assert e.tombstone_ts(b"tk05") is not None
+    assert len({e.merkle_root() for e in engines}) == 1
+
+
+def test_newer_write_beats_older_tombstone_multi(three_nodes):
+    """A deletion only wins keys it is NEWER than: a later write to the
+    same key must overturn an earlier tombstone."""
+    (a_eng, a_srv), (b_eng, b_srv), (c_eng, c_srv) = three_nodes
+    engines = [a_eng, b_eng, c_eng]
+    servers = [a_srv, b_srv, c_srv]
+    for e in engines:
+        fill(e, {"wk": "old"})
+    time.sleep(0.002)
+    a_eng.delete(b"wk")  # tombstone at t1
+    time.sleep(0.002)
+    b_eng.set(b"wk", b"resurrected-on-purpose")  # newer write at t2 > t1
+
+    for _ in range(3):
+        for me in range(3):
+            peers = [
+                f"127.0.0.1:{servers[j].port}" for j in range(3) if j != me
+            ]
+            SyncManager(engines[me], device="cpu").sync_multi(peers)
+
+    for e in engines:
+        assert e.get(b"wk") == b"resurrected-on-purpose"
+    assert len({e.merkle_root() for e in engines}) == 1
+
+
+def test_pairwise_sync_adopts_remote_tombstone_ts(two_nodes):
+    """Pairwise repair deletion adopts the PEER's tombstone timestamp, so
+    the copied deletion keeps its LWW position instead of being stamped
+    'now'."""
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    fill(local_eng, {"dk": "v", "keep": "x"})
+    fill(remote_eng, {"keep": "x", "dk": "v"})
+    remote_eng.delete(b"dk")
+    remote_ts = remote_eng.tombstone_ts(b"dk")
+
+    SyncManager(local_eng, device="cpu").sync_once("127.0.0.1", remote_srv.port)
+    assert local_eng.get(b"dk") is None
+    assert local_eng.tombstone_ts(b"dk") == remote_ts
+
+
+def test_pairwise_mirror_delete_leaves_no_tombstone(two_nodes):
+    """Deleting a local-only key because the peer merely LACKS it is a
+    mirror copy, not a deletion event — no tombstone may be fabricated."""
+    (local_eng, _), (remote_eng, remote_srv) = two_nodes
+    fill(local_eng, {"only-local": "v", "shared": "x"})
+    fill(remote_eng, {"shared": "x"})
+    SyncManager(local_eng, device="cpu").sync_once("127.0.0.1", remote_srv.port)
+    assert local_eng.get(b"only-local") is None
+    assert local_eng.tombstone_ts(b"only-local") is None
+
+
+def test_sync_multi_full_snapshot_fallback_peer(three_nodes):
+    """A reachable peer whose LEAFHASHES fails still joins the cycle via
+    the full-snapshot fallback (ts 0: contributes missing keys, never
+    overwrites fresher state)."""
+    from merklekv_tpu.client import MerkleKVClient as RealClient
+
+    (local_eng, _), (p1_eng, p1_srv), _ = three_nodes
+    fill(p1_eng, {"fb": "from-fallback"})
+    local_eng.set(b"fresh", b"mine")
+
+    mgr = SyncManager(local_eng, device="cpu")
+    orig = RealClient.leaf_hashes_ts
+
+    def broken(self, prefix=""):
+        raise RuntimeError("LEAFHASHES unsupported")
+
+    RealClient.leaf_hashes_ts = broken
+    try:
+        report = mgr.sync_multi([f"127.0.0.1:{p1_srv.port}"])
+    finally:
+        RealClient.leaf_hashes_ts = orig
+    assert local_eng.get(b"fb") == b"from-fallback"  # union still grows
+    assert local_eng.get(b"fresh") == b"mine"  # fallback never overwrites
+    assert any("full snapshot" in d for d in report.details)
