@@ -363,7 +363,10 @@ class SyncManager:
             report = self._sync_multi(peers)
             rec["divergent"] = report.divergent_union
             get_metrics().inc("anti_entropy.multi_syncs")
-            get_metrics().inc("anti_entropy.keys_repaired", report.set_keys)
+            get_metrics().inc(
+                "anti_entropy.keys_repaired",
+                report.set_keys + report.deleted_keys,
+            )
             return report
 
     def _sync_multi(self, peers: list[str]) -> MultiSyncReport:

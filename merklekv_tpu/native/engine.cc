@@ -243,6 +243,7 @@ Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
   // Wrapping add (reference release-mode semantics).
   int64_t next = int64_t(uint64_t(cur) + uint64_t(delta));
   s.map[key] = Entry{std::to_string(next), now_ns()};
+  s.tombs.erase(key);  // live entry supersedes any deletion record
   return Result<int64_t>::Ok(next);
 }
 
@@ -268,6 +269,7 @@ Result<std::string> MemEngine::splice(const std::string& key,
     next = value + it->second.value;
   }
   s.map[key] = Entry{next, now_ns()};
+  s.tombs.erase(key);  // live entry supersedes any deletion record
   return Result<std::string>::Ok(next);
 }
 

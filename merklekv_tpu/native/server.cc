@@ -405,9 +405,10 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       }
       // Tombstones ride along with digest "-": a peer's multi-replica LWW
       // needs deletion timestamps, or a dropped DEL event is undone forever
-      // by any replica still holding the value. A reader that can't parse
-      // "-" treats the whole payload as undecodable and degrades to the
-      // full-snapshot fallback (sync.py _fetch_remote_hashes).
+      // by any replica still holding the value. Current readers that meet
+      // an unknown digest marker treat the payload as undecodable and
+      // degrade to the full-snapshot fallback (sync.py
+      // _fetch_remote_hashes decodes inside its try for exactly this).
       for (const auto& [k, ts] : engine_->tombstones(cmd.prefix)) {
         body += k + " - " + std::to_string(ts) + "\r\n";
         ++listed;

@@ -282,3 +282,29 @@ def test_log_engine_tombstone_survives_compaction():
         with NativeEngine("log", d) as e2:
             assert e2.get(b"live") == b"v"
             assert e2.tombstone_ts(b"dead") == ts
+
+
+def test_incr_append_clear_tombstone(eng):
+    """INCR/DECR/APPEND/PREPEND create live entries — they must supersede a
+    deletion record like SET does, or the key is advertised live AND
+    tombstoned at once (and compaction replay would kill the value)."""
+    eng.set(b"n", b"5")
+    eng.delete(b"n")
+    assert eng.increment(b"n", 2) == 2  # missing counts as 0
+    assert eng.tombstone_ts(b"n") is None
+    eng.delete(b"n")
+    assert eng.append(b"n", b"x") == b"x"
+    assert eng.tombstone_ts(b"n") is None
+
+
+def test_log_engine_incr_after_delete_survives_compact_restart():
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.set(b"n", b"1")
+            e.delete(b"n")
+            e.increment(b"n", 7)
+            assert e.compact()
+            e.sync()
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"n") == b"7"
+            assert e2.tombstone_ts(b"n") is None
