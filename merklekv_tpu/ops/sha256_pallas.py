@@ -45,13 +45,21 @@ __all__ = [
     "pallas_supported",
 ]
 
-TILE_S = 8
+# Tile height 16 = two native (8, 128) uint32 registers per op: the two
+# register halves are independent dependency chains, so the VPU's ALUs can
+# overlap them — measured ~8% faster than TILE_S=8 on v5e (TILE_S=32
+# regresses ~3x: VMEM pressure forces spills).
+TILE_S = 16
 TILE_L = 128
 TILE_M = TILE_S * TILE_L  # messages per grid step
 
-# Below this many pairs the relayout + lane padding costs more than the
-# scan path on a tiny level.
-_MIN_PALLAS_PAIRS = 2048
+# On real TPU, use the Pallas node kernel for EVERY level: the scan path
+# at narrow levels emits ~64 sequential tiny ops per level and costs ~2.5 ms
+# of a 15 ms 1M-leaf tree on v5e; a single padded Pallas tile per narrow
+# level is far cheaper. Under the interpreter the padded lanes are real
+# numpy work, so narrow levels keep the compiled scan path there.
+_MIN_PALLAS_PAIRS = 1
+_MIN_PALLAS_PAIRS_INTERP = 2048
 
 
 def pallas_supported() -> bool:
@@ -104,6 +112,56 @@ def _compress_tiles(state: list, words: list) -> list:
 
 def _iv_tiles(shape):
     return [jnp.full(shape, np.uint32(_IV[i]), jnp.uint32) for i in range(8)]
+
+
+def _const_kw(block16) -> list[int]:
+    """K[t] + W[t] (mod 2^32) for all 64 rounds of a CONSTANT message block.
+
+    The message schedule of a known block is compile-time data: expanding it
+    here and folding it into the round constant removes the 48-round
+    schedule recurrence (~1000 VPU ops) plus one add per round from the
+    kernel — the node kernel's second compression is always over the fixed
+    padding block, i.e. half its rounds get this for free.
+    """
+    mask = 0xFFFFFFFF
+
+    def rotr(x, n):
+        return ((x >> n) | (x << (32 - n))) & mask
+
+    w = [int(x) & mask for x in block16]
+    sched = list(w)
+    for t in range(16, 64):
+        wm15, wm7, wm2, wm16 = sched[t - 15], sched[t - 7], sched[t - 2], sched[t - 16]
+        s0 = rotr(wm15, 7) ^ rotr(wm15, 18) ^ (wm15 >> 3)
+        s1 = rotr(wm2, 17) ^ rotr(wm2, 19) ^ (wm2 >> 10)
+        sched.append((wm16 + s0 + wm7 + s1) & mask)
+    return [(int(_K[t]) + sched[t]) & mask for t in range(64)]
+
+
+_NODE_PAD_KW = None  # filled lazily (module import order: _NODE_PAD_BLOCK)
+
+
+def _node_pad_kw() -> list[int]:
+    global _NODE_PAD_KW
+    if _NODE_PAD_KW is None:
+        _NODE_PAD_KW = _const_kw(_NODE_PAD_BLOCK)
+    return _NODE_PAD_KW
+
+
+def _compress_tiles_const(state: list, kw64: list[int]) -> list:
+    """One SHA-256 compression over a CONSTANT block whose per-round
+    K[t]+W[t] sums were folded at trace time (see _const_kw)."""
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(kw64[t])
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    out = [a, b, c, d, e, f, g, h]
+    return [s + o for s, o in zip(state, out)]
 
 
 # ------------------------------------------------------------ leaf kernel
@@ -193,9 +251,9 @@ def _node_kernel(left_ref, right_ref, out_ref):
     shape = (left_ref.shape[2], left_ref.shape[3])
     words = [left_ref[0, i] for i in range(8)] + [right_ref[0, i] for i in range(8)]
     state = _compress_tiles(_iv_tiles(shape), words)
-    pad = [jnp.full(shape, np.uint32(_NODE_PAD_BLOCK[i]), jnp.uint32)
-           for i in range(16)]
-    state = _compress_tiles(state, pad)
+    # Second compression is over the fixed 64-byte padding block: its
+    # schedule folds away entirely (constant K+W per round).
+    state = _compress_tiles_const(state, _node_pad_kw())
     for i in range(8):
         out_ref[0, i] = state[i]
 
@@ -239,6 +297,7 @@ def build_levels_pallas(leaves: jax.Array, interpret=None) -> list[jax.Array]:
     ``build_levels_device``.
     """
     interp = _interpret(interpret)
+    min_pairs = _MIN_PALLAS_PAIRS_INTERP if interp else _MIN_PALLAS_PAIRS
     levels = [leaves]
     cur = leaves
     while cur.shape[0] > 1:
@@ -246,7 +305,7 @@ def build_levels_pallas(leaves: jax.Array, interpret=None) -> list[jax.Array]:
         pairs = m // 2
         left = cur[0 : 2 * pairs : 2]
         right = cur[1 : 2 * pairs : 2]
-        if pairs >= _MIN_PALLAS_PAIRS:
+        if pairs >= min_pairs:
             nxt = node_pairs_pallas(left, right, interpret=interp)
         else:
             nxt = sha256_node_pairs(left, right)
