@@ -34,9 +34,11 @@ class LWWApplier:
       del_fn(key)                 — plain delete.
       set_ts_fn(key, value, ts)   — install carrying the EVENT's ts; should
                                     be LWW-conditional (engine set_if_newer)
-                                    when the store tracks timestamps.
+                                    when the store tracks timestamps, and
+                                    return truthy iff state advanced.
       del_ts_fn(key, ts)          — delete carrying the event's ts (engine
-                                    del_if_newer records the tombstone).
+                                    del_if_newer records the tombstone);
+                                    returns truthy iff state advanced.
       store_ts_fn(key) -> int     — the store's authoritative last-write
                                     floor for a key: max(entry ts, tombstone
                                     ts), 0 if unknown. Consulted IN ADDITION
@@ -72,7 +74,10 @@ class LWWApplier:
         if ev.op_id in self._seen:
             self.skipped_dup += 1
             return False
-        key = ev.key.encode("utf-8")
+        # surrogateescape round-trips keys that were decoded from non-UTF-8
+        # wire bytes (replicator._to_event) — strict encoding would raise
+        # and the transport callback guard would silently drop the event.
+        key = ev.key.encode("utf-8", "surrogateescape")
         mem_ts = self._last_ts.get(ev.key, 0)
         last_ts = mem_ts
         if self._store_ts is not None:
@@ -81,30 +86,51 @@ class LWWApplier:
             self._remember(ev.op_id)
             self.skipped_lww += 1
             return False
-        # op_id tie-break only against the in-memory record: the store
-        # tracks timestamps, not op ids. After a restart an equal-ts event
-        # re-applies — idempotent for redelivery, and cross-writer equal-ts
-        # conflicts still converge through anti-entropy's digest tie-break.
-        if ev.ts == mem_ts and ev.op_id < self._last_op_id.get(ev.key, b"\0" * 16):
+        # Equal-ts arbitration: with engine-conditional ops wired
+        # (set_ts_fn -> set_if_newer), the ENGINE breaks exact-ts ties by
+        # value digest — a deterministic order that survives applier
+        # restarts and matches anti-entropy's (ts, liveness, digest) rule,
+        # so replication alone converges cross-writer equal-ts conflicts.
+        # An in-memory op_id tie-break here would fight it: after a restart
+        # (maps empty) replicas that applied in different orders would
+        # disagree about which event "came first". Only the plain-callable
+        # path (test doubles without ts tracking) keeps the op_id rule,
+        # since a dict store has no digest arbitration of its own.
+        if (
+            self._set_ts is None
+            and ev.ts == mem_ts
+            and ev.op_id < self._last_op_id.get(ev.key, b"\0" * 16)
+        ):
             self._remember(ev.op_id)
             self.skipped_lww += 1
             return False
 
+        # The ts-carrying fns are LWW-conditional in the engine (set_if_newer
+        # / del_if_newer) and report whether state actually advanced — an
+        # equal-ts digest-losing SET or an already-covered DEL is a rejection
+        # and must count as an LWW skip, not an apply. The plain callables
+        # (dict-store doubles) apply unconditionally.
+        changed = True
         if ev.op is OpKind.DEL:
             if self._del_ts is not None:
-                self._del_ts(key, ev.ts)
+                changed = bool(self._del_ts(key, ev.ts))
             else:
                 self._del(key)
         elif ev.val is not None:
             # Post-op value semantics: INCR/DECR/APPEND/PREPEND all apply as
             # an absolute SET of the result (change_event.rs:17-19).
             if self._set_ts is not None:
-                self._set_ts(key, ev.val, ev.ts)
+                changed = bool(self._set_ts(key, ev.val, ev.ts))
             else:
                 self._set(key, ev.val)
+        else:
+            changed = False  # SET-like op with no value: nothing to install
+        self._remember(ev.op_id)
+        if not changed:
+            self.skipped_lww += 1
+            return False
         self._last_ts[ev.key] = ev.ts
         self._last_op_id[ev.key] = ev.op_id
-        self._remember(ev.op_id)
         self.applied += 1
         return True
 

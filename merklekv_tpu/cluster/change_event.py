@@ -110,6 +110,19 @@ def _cbor_text(s: str) -> bytes:
     return _cbor_head(3, len(e)) + e
 
 
+def _cbor_text_or_bytes(s: str) -> bytes:
+    """Key/src fields: a valid-UTF-8 string is a standard text item; a
+    surrogateescape-decoded raw key (non-UTF-8 wire bytes, from
+    replicator._to_event) is emitted as a BYTE string — RFC 8949 requires
+    text items to be valid UTF-8, and smuggling raw bytes into one would
+    make strict third-party decoders drop the whole event."""
+    try:
+        e = s.encode("utf-8")
+        return _cbor_head(3, len(e)) + e
+    except UnicodeEncodeError:
+        return _cbor_bytes(s.encode("utf-8", "surrogateescape"))
+
+
 _CBOR_NULL = b"\xf6"
 
 
@@ -117,10 +130,10 @@ def encode_cbor(ev: ChangeEvent) -> bytes:
     pairs = [
         (b"\x61v", _cbor_uint(ev.v)),
         (b"\x62op", _cbor_text(ev.op.value)),
-        (b"\x63key", _cbor_text(ev.key)),
+        (b"\x63key", _cbor_text_or_bytes(ev.key)),
         (b"\x63val", _CBOR_NULL if ev.val is None else _cbor_bytes(ev.val)),
         (b"\x62ts", _cbor_uint(ev.ts)),
-        (b"\x63src", _cbor_text(ev.src)),
+        (b"\x63src", _cbor_text_or_bytes(ev.src)),
         (b"\x65op_id", _cbor_bytes(ev.op_id)),
         (b"\x64prev", _CBOR_NULL if ev.prev is None else _cbor_bytes(ev.prev)),
         (b"\x63ttl", _CBOR_NULL if ev.ttl is None else _cbor_uint(ev.ttl)),
@@ -180,7 +193,10 @@ class _CborReader:
         if major == 2:
             return self._take(arg)
         if major == 3:
-            return self._take(arg).decode("utf-8")
+            # Lenient on inbound text (a peer's corrupt bytes degrade to a
+            # representable key instead of killing the decode); our own
+            # emitter never produces invalid text items (_cbor_text_or_bytes).
+            return self._take(arg).decode("utf-8", "surrogateescape")
         if major == 4:
             return [self.item() for _ in range(arg)]
         if major == 5:
@@ -196,15 +212,24 @@ def decode_cbor(data: bytes) -> ChangeEvent:
     return _from_map(m)
 
 
+def _as_key_str(x) -> str:
+    """key/src arrive as text items, or byte strings for non-UTF-8 keys
+    (see _cbor_text_or_bytes); both normalize to the surrogateescape str
+    form the rest of the pipeline uses."""
+    if isinstance(x, (bytes, bytearray)):
+        return bytes(x).decode("utf-8", "surrogateescape")
+    return x
+
+
 def _from_map(m: dict) -> ChangeEvent:
     try:
         return ChangeEvent(
             v=int(m["v"]),
             op=OpKind(m["op"]),
-            key=m["key"],
+            key=_as_key_str(m["key"]),
             val=m["val"],
             ts=int(m["ts"]),
-            src=m["src"],
+            src=_as_key_str(m["src"]),
             op_id=bytes(m["op_id"]),
             prev=None if m.get("prev") is None else bytes(m["prev"]),
             ttl=None if m.get("ttl") is None else int(m["ttl"]),
@@ -220,8 +245,8 @@ _BIN_MAGIC = b"MKB1"
 
 def encode_binary(ev: ChangeEvent) -> bytes:
     """Compact fixed-order binary codec (bincode-role analog)."""
-    key = ev.key.encode("utf-8")
-    src = ev.src.encode("utf-8")
+    key = ev.key.encode("utf-8", "surrogateescape")
+    src = ev.src.encode("utf-8", "surrogateescape")
     out = bytearray(_BIN_MAGIC)
     op_code = list(OpKind).index(ev.op)
     out += struct.pack("<HBQ", ev.v, op_code, ev.ts)
@@ -252,9 +277,9 @@ def decode_binary(data: bytes) -> ChangeEvent:
 
     v, op_code, ts = struct.unpack("<HBQ", take(11))
     (klen,) = struct.unpack("<I", take(4))
-    key = take(klen).decode("utf-8")
+    key = take(klen).decode("utf-8", "surrogateescape")
     (slen,) = struct.unpack("<I", take(4))
-    src = take(slen).decode("utf-8")
+    src = take(slen).decode("utf-8", "surrogateescape")
     op_id = take(16)
     val = None
     if take(1) == b"\x01":

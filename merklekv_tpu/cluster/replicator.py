@@ -84,17 +84,21 @@ class Replicator:
         # rejected at the shard lock, not re-installed. Applies also bypass
         # the server's event queue (no echo loop), so the device mirror is
         # fed inline here — only when the op actually changed state.
-        def _set_ts(k: bytes, v: bytes, ts: int) -> None:
-            if engine.set_if_newer(k, v, ts) and mirror is not None:
+        def _set_ts(k: bytes, v: bytes, ts: int) -> bool:
+            applied = engine.set_if_newer(k, v, ts)
+            if applied and mirror is not None:
                 mirror.apply_one(k, v)
+            return applied
 
         def _del(k: bytes) -> None:
             if engine.delete(k) and mirror is not None:
                 mirror.apply_one(k, None)
 
-        def _del_ts(k: bytes, ts: int) -> None:
-            if engine.delete_if_newer(k, ts) and mirror is not None:
+        def _del_ts(k: bytes, ts: int) -> bool:
+            applied = engine.delete_if_newer(k, ts)
+            if applied and mirror is not None:
                 mirror.apply_one(k, None)
+            return applied
 
         def _store_ts(k: bytes) -> int:
             # The store's LWW floor: live entry ts or tombstone ts. Keeps a

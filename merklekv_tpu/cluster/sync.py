@@ -312,6 +312,8 @@ class SyncManager:
                 self._engine.delete(k)  # engine doubles without quiet mode
             else:
                 self._engine.delete_quiet(k)
+        elif not hasattr(self._engine, "delete_with_ts"):
+            self._engine.delete(k)  # engine doubles without ts-carrying ops
         else:
             self._engine.delete_with_ts(k, tomb_ts)
         if self._repair_listener is not None:

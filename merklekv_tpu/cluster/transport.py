@@ -47,6 +47,7 @@ class InProcessBus:
         self._q: list[tuple[str, bytes]] = []
         self._cv = threading.Condition(self._mu)
         self._closed = False
+        self.callback_errors = 0
         self._thread = threading.Thread(target=self._dispatch, daemon=True)
         self._thread.start()
 
@@ -85,7 +86,10 @@ class InProcessBus:
                     try:
                         cb(topic, payload)
                     except Exception:
-                        pass  # subscriber errors must not kill the bus
+                        # Subscriber errors must not kill the bus, but a
+                        # silently-eaten event is an invisible delivery gap —
+                        # count it so tests/operators can see the drop.
+                        self.callback_errors += 1
 
 
 # ------------------------------------------------------------- TCP broker
@@ -213,6 +217,7 @@ class TcpTransport:
         self._mu = threading.Lock()
         self._send_mu = threading.Lock()
         self._closed = False
+        self.callback_errors = 0
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -252,7 +257,9 @@ class TcpTransport:
                     try:
                         cb(topic, payload)
                     except Exception:
-                        pass
+                        # Count swallowed subscriber errors: a dropped event
+                        # here would otherwise vanish without a trace.
+                        self.callback_errors += 1
 
 
 def make_transport(broker: str, port: int) -> Transport:

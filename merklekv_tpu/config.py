@@ -70,8 +70,14 @@ class Config:
                 setattr(cfg, k, str(raw[k]))
         if "port" in raw:
             cfg.port = int(raw["port"])
+        ae = raw.get("anti_entropy", {})
         if "sync_interval_seconds" in raw:
+            # Reference semantics (config.rs:48-74): the top-level interval
+            # is the sync cadence. Here it seeds the anti-entropy loop's
+            # interval; an explicit [anti_entropy].interval_seconds wins.
             cfg.sync_interval_seconds = float(raw["sync_interval_seconds"])
+            if "interval_seconds" not in ae:
+                cfg.anti_entropy.interval_seconds = cfg.sync_interval_seconds
         rep = raw.get("replication", {})
         for k in ("mqtt_broker", "topic_prefix", "client_id", "username",
                   "password"):
@@ -83,7 +89,6 @@ class Config:
             cfg.replication.mqtt_port = int(rep["mqtt_port"])
         if "peer_list" in rep:
             cfg.replication.peer_list = [str(p) for p in rep["peer_list"]]
-        ae = raw.get("anti_entropy", {})
         if "enabled" in ae:
             cfg.anti_entropy.enabled = bool(ae["enabled"])
         if "interval_seconds" in ae:

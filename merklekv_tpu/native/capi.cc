@@ -184,6 +184,19 @@ long long mkv_engine_memory_usage(void* h) {
   return (long long)static_cast<Engine*>(h)->memory_usage();
 }
 
+// Deletion records evicted by the bounded tombstone map (0 for engines
+// without tombstones).
+long long mkv_engine_tomb_evictions(void* h) {
+  return (long long)static_cast<Engine*>(h)->tomb_evictions();
+}
+
+// 1 when a durable log refused to open because its on-disk format version
+// is newer than this binary (engine runs empty, logging disabled).
+int mkv_engine_log_version_refused(void* h) {
+  auto* log = dynamic_cast<mkv::LogEngine*>(static_cast<Engine*>(h));
+  return log && log->log_version_refused() ? 1 : 0;
+}
+
 int mkv_engine_truncate(void* h) {
   return static_cast<Engine*>(h)->truncate() ? 1 : 0;
 }

@@ -1,6 +1,7 @@
 #include "engine.h"
 
 #include <fcntl.h>
+#include <string.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <time.h>
@@ -12,6 +13,8 @@
 #include <cstring>
 #include <functional>
 #include <mutex>
+
+#include "merkle.h"
 
 namespace mkv {
 
@@ -99,10 +102,14 @@ std::optional<std::pair<std::string, uint64_t>> MemEngine::get_with_ts(
   return std::make_pair(it->second.value, it->second.ts);
 }
 
-void MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
+bool MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
   // Caller holds the shard's unique lock.
   auto [it, inserted] = s.tombs.try_emplace(key, ts);
-  if (!inserted && it->second < ts) it->second = ts;
+  bool advanced = inserted;
+  if (!inserted && it->second < ts) {
+    it->second = ts;
+    advanced = true;
+  }
   if (s.tombs.size() > kMaxTombsPerShard) {
     // Amortized eviction: one scan drops the oldest ~1/8 of the map, so a
     // delete-heavy workload at the cap pays the scan once per ~8k deletes
@@ -126,7 +133,11 @@ void MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
         ++i;
       }
     }
+    // Every evicted record is a deletion the cluster can no longer defend
+    // against stale resurrection — count them (surfaced via STATS).
+    tomb_evictions_.fetch_add(evicted, std::memory_order_relaxed);
   }
+  return advanced;
 }
 
 bool MemEngine::del(const std::string& key) {
@@ -134,10 +145,17 @@ bool MemEngine::del(const std::string& key) {
 }
 
 bool MemEngine::del_with_ts(const std::string& key, uint64_t ts) {
+  bool advanced;
+  return del_with_ts_report(key, ts, &advanced);
+}
+
+bool MemEngine::del_with_ts_report(const std::string& key, uint64_t ts,
+                                   bool* advanced) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
   bool existed = s.map.erase(key) > 0;
-  note_tomb(s, key, ts);
+  bool tomb_advanced = note_tomb(s, key, ts);
+  *advanced = existed || tomb_advanced;
   return existed;
 }
 
@@ -152,7 +170,20 @@ bool MemEngine::set_if_newer(const std::string& key, const std::string& value,
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
   auto it = s.map.find(key);
-  if (it != s.map.end() && ts < it->second.ts) return false;
+  if (it != s.map.end()) {
+    if (ts < it->second.ts) return false;
+    if (ts == it->second.ts && value != it->second.value) {
+      // Exact-ts cross-writer conflict: break deterministically by leaf
+      // digest (larger wins), the same (ts, liveness, digest) order the
+      // multi-peer sync arbitration uses. Replicas applying equal-ts
+      // events in any order therefore converge on the max-digest value
+      // through replication alone — no sync loop required.
+      uint8_t cur[32], neu[32];
+      leaf_hash(key, it->second.value, cur);
+      leaf_hash(key, value, neu);
+      if (::memcmp(neu, cur, 32) < 0) return false;
+    }
+  }
   auto tt = s.tombs.find(key);
   if (tt != s.tombs.end() && ts < tt->second) return false;  // tie: value wins
   s.map[key] = Entry{value, ts};
@@ -167,11 +198,14 @@ bool MemEngine::del_if_newer(const std::string& key, uint64_t ts) {
   if (it != s.map.end()) {
     if (ts <= it->second.ts) return false;  // tie: value wins
     s.map.erase(it);
+    note_tomb(s, key, ts);
+    return true;
   }
-  // Absent key: still record the tombstone — it blocks older writes from
-  // resurrecting later (applied in the "state now matches" sense).
-  note_tomb(s, key, ts);
-  return true;
+  // Absent key: record the tombstone — it blocks older writes from
+  // resurrecting later. "Applied" only if it actually advanced (a newer
+  // tombstone already on record means local state already covers this
+  // deletion, and callers must not log/notify a no-op).
+  return note_tomb(s, key, ts);
 }
 
 std::optional<uint64_t> MemEngine::tombstone_ts(const std::string& key) {
@@ -307,13 +341,25 @@ std::vector<std::pair<std::string, std::string>> MemEngine::snapshot() {
 
 // ------------------------------------------------------------- LogEngine
 //
+// File header (logs created at version >= 2): magic "MKVL" + u32 LE format
+// version. A binary that reads a version NEWER than it supports REFUSES to
+// open (no replay, no truncation) instead of misparsing unknown records as
+// corruption and cutting the file — the downgrade-safety hole a headerless
+// format has. Headerless legacy files replay from offset 0 and are then
+// UPGRADED in place (snapshot rewrite with a header): they may already
+// contain kOpDelTs records that a pre-DelTs binary would misparse as
+// corruption and truncate, so leaving them headerless would preserve
+// nothing — the header is what makes every future format change refusable
+// instead of destructive.
+//
 // Log record: u8 op | u32 klen | u32 vlen | [u64 ts] | key bytes | value
 // bytes, little-endian integers. Ops: 1=SET (legacy, no ts field),
 // 2=DEL, 3=TRUNCATE, 4=SET_TS (carries the entry's last-write unix-ns
-// timestamp so LWW ordering survives restart). New records are written as
-// SET_TS; legacy SET records replay with ts=0 ("unknown age" — loses every
-// LWW tie, which is the conservative choice). A torn tail record (short
-// read) is discarded on replay and truncated from the file.
+// timestamp so LWW ordering survives restart), 5=DEL_TS (v2+). New records
+// are written as SET_TS; legacy SET records replay with ts=0 ("unknown
+// age" — loses every LWW tie, which is the conservative choice). A torn
+// tail record (short read) is discarded on replay and truncated from the
+// file.
 
 namespace {
 constexpr uint8_t kOpSet = 1;
@@ -323,6 +369,13 @@ constexpr uint8_t kOpSetTs = 4;
 // DEL carrying its tombstone timestamp, so deletion LWW ordering survives
 // restart the same way kOpSetTs preserves write ordering.
 constexpr uint8_t kOpDelTs = 5;
+
+constexpr char kLogMagic[4] = {'M', 'K', 'V', 'L'};
+constexpr uint32_t kLogVersion = 2;
+// No record op byte collides with 'M' (0x4D), so magic detection on legacy
+// files can never misfire. Files shorter than the header are legacy too
+// (either empty-after-torn-tail or a partial record).
+constexpr size_t kLogHeaderSize = 8;
 
 bool read_exact(int fd, void* buf, size_t len) {
   uint8_t* p = static_cast<uint8_t*>(buf);
@@ -353,6 +406,8 @@ bool write_all(int fd, const void* buf, size_t len) {
 LogEngine::LogEngine(const std::string& dir) {
   ::mkdir(dir.c_str(), 0755);
   path_ = dir + "/data.log";
+  bool needs_header = true;
+  bool legacy = false;
   int rfd = ::open(path_.c_str(), O_RDONLY);
   if (rfd >= 0) {
     // Byte offset just past the last fully-replayed record. Anything after
@@ -362,6 +417,30 @@ LogEngine::LogEngine(const std::string& dir) {
     const off_t end = ::lseek(rfd, 0, SEEK_END);
     ::lseek(rfd, 0, SEEK_SET);
     off_t good = 0;
+    if (end >= off_t(kLogHeaderSize)) {
+      char head[kLogHeaderSize];
+      if (read_exact(rfd, head, kLogHeaderSize) &&
+          ::memcmp(head, kLogMagic, 4) == 0) {
+        uint32_t ver;
+        ::memcpy(&ver, head + 4, 4);
+        if (ver > kLogVersion) {
+          // A future format: refuse rather than truncate. The file is left
+          // byte-identical; the engine runs empty with logging disabled so
+          // nothing this binary does can damage the newer log.
+          ::close(rfd);
+          version_refused_ = true;
+          fd_ = -1;
+          return;
+        }
+        good = off_t(kLogHeaderSize);
+        needs_header = false;  // header already on disk
+      } else {
+        ::lseek(rfd, 0, SEEK_SET);  // legacy headerless file
+        legacy = true;
+      }
+    } else if (end > 0) {
+      legacy = true;  // short legacy tail; replay handles it
+    }
     for (;;) {
       uint8_t op;
       uint32_t klen, vlen;
@@ -400,7 +479,22 @@ LogEngine::LogEngine(const std::string& dir) {
     ::close(rfd);
     if (end > good) ::truncate(path_.c_str(), good);
   }
+  if (legacy) {
+    // Upgrade in place: rewrite the replayed state as a headered v2
+    // snapshot (atomic tmp+rename, like compact()). On any failure fall
+    // through to plain append — the data is already replayed, and the
+    // next successful compaction upgrades it instead.
+    if (rewrite_snapshot()) return;  // rewrite_snapshot set fd_
+  }
   fd_ = ::open(path_.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd_ >= 0 && needs_header && !legacy) write_header(fd_);
+}
+
+bool LogEngine::write_header(int fd) {
+  char head[kLogHeaderSize];
+  ::memcpy(head, kLogMagic, 4);
+  ::memcpy(head + 4, &kLogVersion, 4);
+  return write_all(fd, head, kLogHeaderSize);
 }
 
 LogEngine::~LogEngine() {
@@ -438,6 +532,7 @@ bool LogEngine::set_with_ts(const std::string& key, const std::string& value,
                             uint64_t ts) {
   // Mutations serialize on log_mu_ so replay order matches final state.
   std::unique_lock lk(log_mu_);
+  if (version_refused_) return false;  // nothing may touch a refused log
   if (!mem_.set_with_ts(key, value, ts)) return false;
   return append_record(kOpSetTs, key, value, ts);
 }
@@ -457,15 +552,20 @@ bool LogEngine::del(const std::string& key) {
 
 bool LogEngine::del_with_ts(const std::string& key, uint64_t ts) {
   std::unique_lock lk(log_mu_);
-  bool existed = mem_.del_with_ts(key, ts);
-  // Logged even when the key is absent: the tombstone itself is state
-  // (it must keep blocking older writes after a restart).
-  append_record(kOpDelTs, key, "", ts);
+  if (version_refused_) return false;
+  bool advanced;
+  bool existed = mem_.del_with_ts_report(key, ts, &advanced);
+  // Logged even when the key is absent — the tombstone itself is state (it
+  // must keep blocking older writes after a restart) — but ONLY when the
+  // entry or tombstone actually advanced: DEL-miss-heavy traffic must not
+  // grow the log without bound between compactions.
+  if (advanced) append_record(kOpDelTs, key, "", ts);
   return existed;
 }
 
 bool LogEngine::del_quiet(const std::string& key) {
   std::unique_lock lk(log_mu_);
+  if (version_refused_) return false;
   bool existed = mem_.del_quiet(key);
   if (existed) append_record(kOpDel, key, "", 0);
   return existed;
@@ -474,6 +574,7 @@ bool LogEngine::del_quiet(const std::string& key) {
 bool LogEngine::set_if_newer(const std::string& key, const std::string& value,
                              uint64_t ts) {
   std::unique_lock lk(log_mu_);
+  if (version_refused_) return false;
   if (!mem_.set_if_newer(key, value, ts)) return false;
   append_record(kOpSetTs, key, value, ts);
   return true;
@@ -481,6 +582,7 @@ bool LogEngine::set_if_newer(const std::string& key, const std::string& value,
 
 bool LogEngine::del_if_newer(const std::string& key, uint64_t ts) {
   std::unique_lock lk(log_mu_);
+  if (version_refused_) return false;
   if (!mem_.del_if_newer(key, ts)) return false;
   append_record(kOpDelTs, key, "", ts);
   return true;
@@ -506,6 +608,8 @@ size_t LogEngine::memory_usage() { return mem_.memory_usage(); }
 
 Result<int64_t> LogEngine::increment(const std::string& key, int64_t amount) {
   std::unique_lock lk(log_mu_);
+  if (version_refused_)
+    return Result<int64_t>::Err("log format version refused");
   auto r = mem_.increment(key, amount);
   if (r.ok) {
     append_record(kOpSetTs, key, std::to_string(r.value),
@@ -516,6 +620,8 @@ Result<int64_t> LogEngine::increment(const std::string& key, int64_t amount) {
 
 Result<int64_t> LogEngine::decrement(const std::string& key, int64_t amount) {
   std::unique_lock lk(log_mu_);
+  if (version_refused_)
+    return Result<int64_t>::Err("log format version refused");
   auto r = mem_.decrement(key, amount);
   if (r.ok) {
     append_record(kOpSetTs, key, std::to_string(r.value),
@@ -527,6 +633,8 @@ Result<int64_t> LogEngine::decrement(const std::string& key, int64_t amount) {
 Result<std::string> LogEngine::append(const std::string& key,
                                       const std::string& value) {
   std::unique_lock lk(log_mu_);
+  if (version_refused_)
+    return Result<std::string>::Err("log format version refused");
   auto r = mem_.append(key, value);
   if (r.ok) append_record(kOpSetTs, key, r.value, mem_.get_ts(key).value_or(0));
   return r;
@@ -535,6 +643,8 @@ Result<std::string> LogEngine::append(const std::string& key,
 Result<std::string> LogEngine::prepend(const std::string& key,
                                        const std::string& value) {
   std::unique_lock lk(log_mu_);
+  if (version_refused_)
+    return Result<std::string>::Err("log format version refused");
   auto r = mem_.prepend(key, value);
   if (r.ok) append_record(kOpSetTs, key, r.value, mem_.get_ts(key).value_or(0));
   return r;
@@ -542,10 +652,14 @@ Result<std::string> LogEngine::prepend(const std::string& key,
 
 bool LogEngine::truncate() {
   std::unique_lock lk(log_mu_);
+  // A refused (future-version) log must never be O_TRUNC'd: the constructor
+  // promised the file stays byte-identical for the newer binary.
+  if (version_refused_) return false;
   mem_.truncate();
   // Truncating makes all history dead weight: restart the log.
   if (fd_ >= 0) ::close(fd_);
   fd_ = ::open(path_.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd_ >= 0) write_header(fd_);
   return fd_ >= 0;
 }
 
@@ -560,10 +674,25 @@ std::vector<std::pair<std::string, std::string>> LogEngine::snapshot() {
 
 bool LogEngine::compact() {
   std::unique_lock lk(log_mu_);
+  // Compacting a refused log would rename an empty snapshot over the
+  // future-version file — exactly the data loss the refusal prevents.
+  if (version_refused_) return false;
+  return rewrite_snapshot();
+}
+
+// Rewrites the log as a headered v2 snapshot of current state (live
+// entries + tombstones), atomically via tmp+rename, and reopens fd_ for
+// append. Caller holds log_mu_ (or is the constructor, pre-concurrency).
+bool LogEngine::rewrite_snapshot() {
   auto snap = mem_.snapshot();
   std::string tmp = path_ + ".compact";
   int nfd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
   if (nfd < 0) return false;
+  if (!write_header(nfd)) {
+    ::close(nfd);
+    ::unlink(tmp.c_str());
+    return false;
+  }
   auto emit = [&](uint8_t op, const std::string& k, const std::string& v,
                   uint64_t ts) {
     std::string rec;

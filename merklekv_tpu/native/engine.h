@@ -12,6 +12,7 @@
 //     TPU data plane can rebuild Merkle state as a batched program.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <memory>
 #include <optional>
@@ -98,6 +99,11 @@ class Engine {
   virtual bool sync() = 0;      // flush to durable storage (no-op in-mem)
   // Whole keyspace, sorted by key — the TPU rebuild input.
   virtual std::vector<std::pair<std::string, std::string>> snapshot() = 0;
+  // Deletion records dropped by the bounded tombstone map (see
+  // kMaxTombsPerShard). Beyond the cap an old deletion can be resurrected
+  // by a stale replica; this counter makes that silent degradation visible
+  // (surfaced via STATS as tombstone_evictions).
+  virtual uint64_t tomb_evictions() { return 0; }
 };
 
 // In-memory engine: 16-way sharded hash map, per-shard reader/writer locks.
@@ -114,6 +120,12 @@ class MemEngine : public Engine {
       const std::string& key) override;
   bool del(const std::string& key) override;
   bool del_with_ts(const std::string& key, uint64_t ts) override;
+  // del_with_ts that also reports whether any state advanced (entry removed
+  // OR tombstone inserted/moved forward). LogEngine uses it to skip log
+  // appends for no-op deletes (repeated DELs of an absent key would
+  // otherwise grow the log without bound between compactions).
+  bool del_with_ts_report(const std::string& key, uint64_t ts,
+                          bool* advanced);
   bool del_quiet(const std::string& key) override;
   bool set_if_newer(const std::string& key, const std::string& value,
                     uint64_t ts) override;
@@ -134,6 +146,9 @@ class MemEngine : public Engine {
   bool truncate() override;
   bool sync() override { return true; }
   std::vector<std::pair<std::string, std::string>> snapshot() override;
+  uint64_t tomb_evictions() override {
+    return tomb_evictions_.load(std::memory_order_relaxed);
+  }
 
  private:
   struct Entry {
@@ -145,17 +160,21 @@ class MemEngine : public Engine {
     std::unordered_map<std::string, Entry> map;
     // key -> deletion ts. Bounded (kMaxTombsPerShard): the oldest tombstone
     // is evicted on overflow — an evicted tombstone degrades to the
-    // reference's no-tombstone behavior for that key, never worse.
+    // reference's no-tombstone behavior for that key, never worse — and
+    // every eviction is counted (tomb_evictions_).
     std::unordered_map<std::string, uint64_t> tombs;
   };
   static constexpr size_t kMaxTombsPerShard = 1 << 16;
-  static void note_tomb(Shard& s, const std::string& key, uint64_t ts);
+  // Records the deletion; returns whether the tombstone advanced (new, or
+  // moved to a later ts). Caller holds the shard's unique lock.
+  bool note_tomb(Shard& s, const std::string& key, uint64_t ts);
   Shard& shard_for(const std::string& key);
   Result<int64_t> add(const std::string& key, int64_t delta);
   Result<std::string> splice(const std::string& key, const std::string& value,
                              bool append);
 
   Shard shards_[kShards];
+  std::atomic<uint64_t> tomb_evictions_{0};
 };
 
 // Durable engine: MemEngine semantics + append-only operation log
@@ -197,18 +216,26 @@ class LogEngine : public Engine {
   bool truncate() override;
   bool sync() override;
   std::vector<std::pair<std::string, std::string>> snapshot() override;
+  uint64_t tomb_evictions() override { return mem_.tomb_evictions(); }
 
   // Rewrite the log as a snapshot of live state (drops tombstones).
   bool compact();
+  // True when the on-disk log declared a format version newer than this
+  // binary supports: replay was refused (nothing truncated, nothing lost)
+  // and the engine runs empty with logging disabled.
+  bool log_version_refused() const { return version_refused_; }
 
  private:
   bool append_record(uint8_t op, const std::string& key,
                      const std::string& value, uint64_t ts);
+  static bool write_header(int fd);
+  bool rewrite_snapshot();
 
   MemEngine mem_;
   std::string path_;
   std::shared_mutex log_mu_;
   int fd_ = -1;
+  bool version_refused_ = false;
 };
 
 // Factory: kind is "mem" (default, aka "rwlock"/"kv") or "log" (aka "sled").

@@ -423,7 +423,10 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       return "OK\r\n";
     }
     case Verb::Stats:
-      return "STATS\r\n" + stats_.format_stats() + "END\r\n";
+      // Engine-level line after the reference counter set: deletion records
+      // silently dropped by the bounded tombstone map (engine.h).
+      return "STATS\r\n" + stats_.format_stats() + "tombstone_evictions:" +
+             std::to_string(engine_->tomb_evictions()) + "\r\nEND\r\n";
     case Verb::Info: {
       std::string out = "INFO\r\n";
       out += "version:" + opts_.version + "\r\n";

@@ -115,6 +115,9 @@ def _load() -> ctypes.CDLL:
     lib.mkv_engine_dbsize.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_memory_usage.restype = ctypes.c_longlong
     lib.mkv_engine_memory_usage.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_tomb_evictions.restype = ctypes.c_longlong
+    lib.mkv_engine_tomb_evictions.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_log_version_refused.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_truncate.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_compact.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_sync.argtypes = [ctypes.c_void_p]
@@ -308,6 +311,18 @@ class NativeEngine:
 
     def memory_usage(self) -> int:
         return self._lib.mkv_engine_memory_usage(self._h)
+
+    def tomb_evictions(self) -> int:
+        """Deletion records dropped by the bounded tombstone map — each one
+        is a delete the cluster can no longer defend against resurrection
+        by a stale replica (surfaced via STATS as tombstone_evictions)."""
+        return self._lib.mkv_engine_tomb_evictions(self._h)
+
+    def log_version_refused(self) -> bool:
+        """True when a durable log refused to open because its on-disk
+        format version is newer than this binary supports (the file is left
+        untouched; the engine runs empty with logging disabled)."""
+        return bool(self._lib.mkv_engine_log_version_refused(self._h))
 
     def truncate(self) -> None:
         self._lib.mkv_engine_truncate(self._h)

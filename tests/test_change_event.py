@@ -157,3 +157,32 @@ def test_per_key_independence(store_and_applier):
     a.apply(ev(key="a", ts=100, val=b"1"))
     assert a.apply(ev(key="b", ts=50, val=b"2"))  # other key, older ts fine
     assert store == {b"a": b"1", b"b": b"2"}
+
+
+def test_codecs_round_trip_non_utf8_key():
+    """Keys/src that are surrogateescape-decoded raw bytes survive every
+    codec (CBOR text items carry the raw bytes; JSON escapes surrogates)."""
+    from merklekv_tpu.cluster.change_event import (
+        decode_binary,
+        decode_cbor,
+        decode_json,
+        encode_binary,
+        encode_json,
+    )
+
+    raw = b"k\xff\x00\xfe"
+    ev = ChangeEvent(
+        op=OpKind.SET,
+        key=raw.decode("utf-8", "surrogateescape"),
+        val=b"v",
+        ts=7,
+        src="s",
+    )
+    for enc, dec in (
+        (encode_cbor, decode_cbor),
+        (encode_binary, decode_binary),
+        (encode_json, decode_json),
+    ):
+        out = dec(enc(ev))
+        assert out.key == ev.key
+        assert out.key.encode("utf-8", "surrogateescape") == raw

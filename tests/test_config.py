@@ -1,0 +1,78 @@
+"""Config parsing: TOML schema, env-first secrets, interval wiring.
+
+Reference analog: /root/reference/src/config.rs:171-227 (load test) plus the
+top-level sync_interval_seconds semantics (config.rs:48-74).
+"""
+
+from merklekv_tpu.config import Config
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.port == 7379
+    assert cfg.engine == "mem"
+    assert cfg.anti_entropy.interval_seconds == 60.0
+
+
+def test_top_level_sync_interval_seeds_anti_entropy():
+    cfg = Config.from_dict({"sync_interval_seconds": 12})
+    assert cfg.sync_interval_seconds == 12.0
+    # Reference semantics: the top-level interval IS the sync cadence.
+    assert cfg.anti_entropy.interval_seconds == 12.0
+
+
+def test_explicit_anti_entropy_interval_wins():
+    cfg = Config.from_dict(
+        {
+            "sync_interval_seconds": 12,
+            "anti_entropy": {"interval_seconds": 3},
+        }
+    )
+    assert cfg.sync_interval_seconds == 12.0
+    assert cfg.anti_entropy.interval_seconds == 3.0
+
+
+def test_full_table_parse(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text(
+        """
+host = "0.0.0.0"
+port = 7380
+engine = "log"
+storage_path = "/tmp/x"
+sync_interval_seconds = 30
+
+[replication]
+enabled = true
+mqtt_broker = "broker.example"
+mqtt_port = 1884
+topic_prefix = "t"
+client_id = "n1"
+peer_list = ["a", "b"]
+
+[anti_entropy]
+enabled = true
+peers = ["h:1", "h:2"]
+multi_peer = true
+"""
+    )
+    cfg = Config.load(str(p))
+    assert cfg.host == "0.0.0.0"
+    assert cfg.port == 7380
+    assert cfg.engine == "log"
+    assert cfg.replication.enabled
+    assert cfg.replication.mqtt_port == 1884
+    assert cfg.replication.peer_list == ["a", "b"]
+    assert cfg.anti_entropy.enabled
+    assert cfg.anti_entropy.peers == ["h:1", "h:2"]
+    assert cfg.anti_entropy.multi_peer
+    # No explicit [anti_entropy].interval_seconds: top-level seeds it.
+    assert cfg.anti_entropy.interval_seconds == 30.0
+
+
+def test_env_first_secrets(monkeypatch):
+    monkeypatch.setenv("CLIENT_ID", "env-id")
+    monkeypatch.setenv("CLIENT_PASSWORD", "env-pw")
+    cfg = Config.from_dict({"replication": {"client_id": "file-id"}})
+    assert cfg.replication.client_id == "env-id"
+    assert cfg.replication.password == "env-pw"

@@ -21,6 +21,18 @@ from merklekv_tpu.config import Config
 from merklekv_tpu.native_bindings import NativeEngine, NativeServer
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _prewarm_jax():
+    """First-use JAX compile of the device tree takes seconds under full-suite
+    load; warm it once so client calls inside tests never absorb that cost
+    (the historical flake: a 5 s client timeout racing the warm thread)."""
+    from merklekv_tpu.merkle.incremental import DeviceMerkleState
+
+    st = DeviceMerkleState.from_items([(b"warm", b"up")])
+    st.apply([(b"warm", b"again")])
+    _ = st.root_hex()
+
+
 @pytest.fixture
 def broker():
     b = TcpBroker()
@@ -41,7 +53,9 @@ class Node:
         cfg.replication.client_id = node_id
         self.cluster = ClusterNode(cfg, self.engine, self.server)
         self.cluster.start()
-        self.client = MerkleKVClient("127.0.0.1", self.server.port).connect()
+        self.client = MerkleKVClient(
+            "127.0.0.1", self.server.port, timeout=30.0
+        ).connect()
 
     def close(self):
         self.client.close()

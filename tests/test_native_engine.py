@@ -308,3 +308,144 @@ def test_log_engine_incr_after_delete_survives_compact_restart():
         with NativeEngine("log", d) as e2:
             assert e2.get(b"n") == b"7"
             assert e2.tombstone_ts(b"n") is None
+
+
+def test_equal_ts_conflict_converges_by_digest(eng):
+    """Exact-ts cross-writer conflict: set_if_newer breaks the tie by leaf
+    digest (larger wins), so replicas applying in either order agree."""
+    from merklekv_tpu.merkle.encoding import leaf_hash
+
+    a, b = b"va", b"vb"
+    winner = a if leaf_hash(b"ck", a) > leaf_hash(b"ck", b) else b
+    # Order 1: a then b.
+    eng.set_if_newer(b"ck", a, 100)
+    eng.set_if_newer(b"ck", b, 100)
+    assert eng.get(b"ck") == winner
+    # Order 2 on a fresh engine: b then a — same winner.
+    with NativeEngine("mem") as e2:
+        e2.set_if_newer(b"ck", b, 100)
+        e2.set_if_newer(b"ck", a, 100)
+        assert e2.get(b"ck") == winner
+    # Idempotent redelivery of the same value at the same ts still applies.
+    assert eng.set_if_newer(b"ck", winner, 100)
+
+
+def test_del_if_newer_noop_when_tombstone_newer(eng):
+    eng.delete_with_ts(b"dk", 200)
+    # An older deletion arriving late must report NOT applied (state did
+    # not advance) so callers don't log/notify a no-op.
+    assert not eng.delete_if_newer(b"dk", 100)
+    assert eng.delete_if_newer(b"dk", 300)
+    assert eng.tombstone_ts(b"dk") == 300
+
+
+def test_log_engine_noop_deletes_do_not_grow_log():
+    import os
+
+    with tempfile.TemporaryDirectory() as d:
+        with NativeEngine("log", d) as e:
+            e.delete_with_ts(b"absent", 100)
+            e.sync()
+            size1 = os.path.getsize(os.path.join(d, "data.log"))
+            # Re-deleting with the same/older ts advances nothing: the log
+            # must not grow (DEL-miss-heavy traffic between compactions).
+            for _ in range(50):
+                e.delete_with_ts(b"absent", 100)
+                e.delete_with_ts(b"absent", 50)
+                e.delete_if_newer(b"absent", 90)
+            e.sync()
+            assert os.path.getsize(os.path.join(d, "data.log")) == size1
+
+
+def test_log_engine_version_header_and_downgrade_refusal():
+    import os
+    import struct
+
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "data.log")
+        with NativeEngine("log", d) as e:
+            e.set(b"k", b"v")
+            e.sync()
+            assert not e.log_version_refused()
+        with open(log, "rb") as f:
+            head = f.read(8)
+        assert head[:4] == b"MKVL"
+        assert struct.unpack("<I", head[4:])[0] == 2
+        # Forge a future format version: the engine must refuse to replay
+        # AND leave the file byte-identical (the old failure mode was
+        # parsing unknown records as corruption and truncating the log).
+        with open(log, "r+b") as f:
+            f.seek(4)
+            f.write(struct.pack("<I", 99))
+        before = open(log, "rb").read()
+        with NativeEngine("log", d) as e2:
+            assert e2.log_version_refused()
+            assert e2.get(b"k") is None  # refused: nothing replayed
+            # Writes fail LOUDLY (the log can't record them) instead of
+            # silently pretending to be durable.
+            with pytest.raises(NativeError):
+                e2.set(b"refused", b"x")
+            # TRUNCATE (FLUSHDB) and compaction must not destroy the
+            # refused file either — both would rewrite it as an empty v2
+            # log, which is exactly the data loss the refusal prevents.
+            e2.truncate()
+            assert not e2.compact()
+        assert open(log, "rb").read() == before
+
+
+def test_log_engine_legacy_headerless_log_upgrades_on_open():
+    """A legacy headerless log replays and is UPGRADED in place to a
+    headered v2 snapshot: headerless files can already hold kOpDelTs
+    records that a pre-DelTs binary would misparse as corruption and
+    truncate, so the header (refuse-don't-truncate) is the only real
+    downgrade protection."""
+    import os
+    import struct
+
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "data.log")
+        # Hand-write legacy records: op=kOpSetTs(4) klen vlen ts key val,
+        # plus a kOpDelTs(5) tombstone — both existed before the header.
+        with open(log, "wb") as f:
+            f.write(struct.pack("<BII", 4, 3, 2) + struct.pack("<Q", 7)
+                    + b"old" + b"vv")
+            f.write(struct.pack("<BII", 5, 4, 0) + struct.pack("<Q", 9)
+                    + b"dead")
+        with NativeEngine("log", d) as e:
+            assert e.get(b"old") == b"vv"
+            assert e.tombstone_ts(b"dead") == 9
+            e.set(b"new", b"nn")
+            e.sync()
+        with open(log, "rb") as f:
+            head = f.read(8)
+        assert head[:4] == b"MKVL"  # upgraded on open
+        assert struct.unpack("<I", head[4:])[0] == 2
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"old") == b"vv"
+            assert e2.get(b"new") == b"nn"
+            assert e2.tombstone_ts(b"dead") == 9  # tombstone survived upgrade
+
+
+def test_log_engine_garbage_short_file_gets_header():
+    """A 1-7 byte torn/garbage file must not condemn the log to staying
+    headerless forever: it is truncated and rewritten as a headered file."""
+    import os
+    import struct
+
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "data.log")
+        with open(log, "wb") as f:
+            f.write(b"\x01\xff\xff")  # 3-byte torn record
+        with NativeEngine("log", d) as e:
+            e.set(b"fresh", b"1")
+            e.sync()
+        with open(log, "rb") as f:
+            assert f.read(4) == b"MKVL"
+        with NativeEngine("log", d) as e2:
+            assert e2.get(b"fresh") == b"1"
+
+
+def test_tomb_evictions_counter(eng):
+    assert eng.tomb_evictions() == 0
+    eng.delete_with_ts(b"t1", 10)
+    assert eng.tomb_evictions() == 0  # far below the per-shard cap

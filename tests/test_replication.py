@@ -256,3 +256,70 @@ def test_interleaved_events_and_repairs_converge_any_order():
         random.Random(trial).shuffle(order)
         state = run(order, restart_at=random.Random(trial + 100).randrange(len(ops)))
         assert state == reference_state, f"order {order} diverged"
+
+
+def test_non_utf8_key_replicates(pair, broker):
+    """A key whose bytes are not valid UTF-8 must replicate end-to-end:
+    surrogateescape decode (replicator._to_event), surrogateescape codec
+    round-trip, and surrogateescape re-encode in the applier. Historically
+    the strict encode raised and the transport guard ate the event."""
+    n1, n2 = pair
+    raw_key = b"bin\xff\xfekey"
+    ev = ChangeEvent(
+        op=OpKind.SET,
+        key=raw_key.decode("utf-8", "surrogateescape"),
+        val=b"binval",
+        ts=time.time_ns(),
+        src="rogue",
+    )
+    topic = n1.cluster._cfg.replication.topic_prefix + "/events"
+    rogue = TcpTransport(broker.host, broker.port)
+    try:
+        rogue.publish(topic, encode_cbor(ev))
+        assert wait_for(lambda: n2.engine.get(raw_key) == b"binval")
+        assert wait_for(lambda: n1.engine.get(raw_key) == b"binval")
+        # The event must have been applied, not swallowed by the callback
+        # guard (the pre-fix failure mode).
+        assert n2.cluster.replicator._transport.callback_errors == 0
+    finally:
+        rogue.close()
+
+
+def test_equal_ts_cross_writer_converges_without_sync():
+    """Two replicas apply the same pair of equal-ts events from different
+    writers in OPPOSITE orders. The engine's digest tie-break (set_if_newer)
+    must land both on the same value — replication alone converges, no
+    anti-entropy needed (historically the applier's in-memory op_id
+    tie-break made this order-dependent after a restart)."""
+    from merklekv_tpu.cluster.applier import LWWApplier
+    from merklekv_tpu.native_bindings import NativeEngine
+
+    ts = time.time_ns()
+    ev_a = ChangeEvent(op=OpKind.SET, key="eq", val=b"alpha", ts=ts,
+                       src="w1", op_id=b"\x01" * 16)
+    ev_b = ChangeEvent(op=OpKind.SET, key="eq", val=b"beta", ts=ts,
+                       src="w2", op_id=b"\x02" * 16)
+
+    def engine_applier(engine):
+        return LWWApplier(
+            engine.set,
+            lambda k: engine.delete(k),
+            set_ts_fn=lambda k, v, t: engine.set_if_newer(k, v, t),
+            del_ts_fn=lambda k, t: engine.delete_if_newer(k, t),
+            store_ts_fn=lambda k: max(
+                engine.get_ts(k) or 0, engine.tombstone_ts(k) or 0
+            ),
+        )
+
+    e1, e2 = NativeEngine("mem"), NativeEngine("mem")
+    try:
+        a1, a2 = engine_applier(e1), engine_applier(e2)
+        a1.apply(ev_a)
+        a1.apply(ev_b)
+        a2.apply(ev_b)
+        a2.apply(ev_a)
+        assert e1.get(b"eq") == e2.get(b"eq")
+        assert e1.get(b"eq") in (b"alpha", b"beta")
+    finally:
+        e1.close()
+        e2.close()
