@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from merklekv_tpu.merkle.jax_engine import leaf_digests
+from merklekv_tpu.ops.dispatch import hash_node_pairs, use_pallas
 from merklekv_tpu.ops.sha256 import digest_to_bytes, sha256_node_pairs
 
 __all__ = ["DeviceMerkleState"]
@@ -56,20 +57,26 @@ def _bucket(k: int) -> int:
 
 
 def _reduce_levels(leaves: jax.Array) -> tuple:
-    """All padded-tree levels bottom-up; trace-time loop, static shapes."""
+    """All padded-tree levels bottom-up; trace-time loop, static shapes.
+    Node hashing is backend-dispatched (Pallas on TPU, scan elsewhere)."""
     levels = [leaves]
     cur = leaves
     while cur.shape[0] > 1:
-        cur = sha256_node_pairs(cur[0::2], cur[1::2])
+        cur = hash_node_pairs(cur[0::2], cur[1::2])
         levels.append(cur)
     return tuple(levels)
 
 
+# The compiled-program caches below key on use_pallas() so a backend flip
+# between traces (tests forcing MKV_SHA256_BACKEND) can't replay a program
+# compiled for the other formulation.
+
 @lru_cache(maxsize=None)
-def _build_fn(capacity: int):
+def _build_fn(capacity: int, pallas: bool):
     """Compiled initial build over capacity-padded leaves: one compile per
     capacity bucket, shared by every live count within it (the caller pads
     the digest array to C on the host)."""
+    del pallas  # cache key only; _reduce_levels re-reads the dispatch
 
     @jax.jit
     def go(leaves: jax.Array):
@@ -79,8 +86,9 @@ def _build_fn(capacity: int):
 
 
 @lru_cache(maxsize=None)
-def _scatter_update_fn(capacity: int, kb: int):
+def _scatter_update_fn(capacity: int, kb: int, pallas: bool):
     """Compiled scatter + path re-reduction for (capacity, batch bucket)."""
+    del pallas
 
     @jax.jit
     def go(levels: tuple, idx: jax.Array, new_leaves: jax.Array):
@@ -93,7 +101,7 @@ def _scatter_update_fn(capacity: int, kb: int):
             cur_idx = cur_idx // 2
             left = out[-1][2 * cur_idx]
             right = out[-1][2 * cur_idx + 1]
-            parents = sha256_node_pairs(left, right)
+            parents = hash_node_pairs(left, right)
             out.append(levels[lvl].at[cur_idx].set(parents))
         return tuple(out)
 
@@ -101,7 +109,7 @@ def _scatter_update_fn(capacity: int, kb: int):
 
 
 @lru_cache(maxsize=None)
-def _restructure_fn(c_old: int, c_new: int, kb: int):
+def _restructure_fn(c_old: int, c_new: int, kb: int, pallas: bool):
     """Compiled gather + scatter + full reduction for shape changes.
 
     gather_idx [c_new] int32: source slot in the OLD leaf level for each new
@@ -109,6 +117,7 @@ def _restructure_fn(c_old: int, c_new: int, kb: int):
     fresh_pos [kb] int32 + fresh [kb, 8]: the k changed/inserted digests
     (padded entries duplicate entry 0 — same value, benign).
     """
+    del pallas
 
     @jax.jit
     def go(old_leaves, gather_idx, fresh_pos, fresh):
@@ -258,7 +267,7 @@ class DeviceMerkleState:
         new_leaves = jnp.concatenate(
             [digests, jnp.broadcast_to(digests[0], (kb - k, 8))], axis=0
         ) if kb > k else digests
-        fn = _scatter_update_fn(self._capacity, kb)
+        fn = _scatter_update_fn(self._capacity, kb, use_pallas())
         self._levels = fn(self._levels, jnp.asarray(idx), new_leaves)
         self.incremental_batches += 1
 
@@ -269,7 +278,7 @@ class DeviceMerkleState:
         digests = np.asarray(leaf_digests(list(keys_arr), values))
         padded = np.zeros((c, 8), np.uint32)
         padded[:n] = digests
-        self._levels = _build_fn(c)(jnp.asarray(padded))
+        self._levels = _build_fn(c, use_pallas())(jnp.asarray(padded))
         self._keys = keys_arr
         self._capacity = c
         self.full_rebuilds += 1
@@ -336,7 +345,7 @@ class DeviceMerkleState:
             fresh_pos = np.zeros(0, np.int32)
             fresh = jnp.zeros((0, 8), jnp.uint32)
 
-        fn = _restructure_fn(self._capacity, c_new, kb)
+        fn = _restructure_fn(self._capacity, c_new, kb, use_pallas())
         self._levels = fn(
             self._levels[0], jnp.asarray(gather_padded),
             jnp.asarray(fresh_pos), fresh,

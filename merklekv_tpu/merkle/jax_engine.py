@@ -90,10 +90,18 @@ def _leaf_digests_jit(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
 
 
 def leaf_digests(keys: Sequence[bytes], values: Sequence[bytes]) -> jax.Array:
-    """[N, 8] uint32 leaf digests for N (key, value) pairs, hashed on device."""
+    """[N, 8] uint32 leaf digests for N (key, value) pairs, hashed on device.
+
+    Backend-dispatched: Pallas kernels on TPU (ops/dispatch.py), the scan
+    formulation elsewhere — so every caller (mirror warm build, incremental
+    tree, sync leaf maps) gets the tuned production path on the chip."""
+    from merklekv_tpu.ops.dispatch import hash_blocks, use_pallas
+
     packed = pack_leaves(list(keys), list(values))
     if packed.n == 0:
         return jnp.zeros((0, 8), jnp.uint32)
+    if use_pallas():
+        return hash_blocks(packed.blocks, packed.nblocks)
     return _leaf_digests_jit(packed.blocks, packed.nblocks)
 
 

@@ -218,7 +218,9 @@ class LogEngine : public Engine {
   std::vector<std::pair<std::string, std::string>> snapshot() override;
   uint64_t tomb_evictions() override { return mem_.tomb_evictions(); }
 
-  // Rewrite the log as a snapshot of live state (drops tombstones).
+  // Rewrite the log as a snapshot of current state — live entries AND
+  // tombstones (dropping deletion records would let older writes resurrect
+  // deleted keys after a compaction + restart).
   bool compact();
   // True when the on-disk log declared a format version newer than this
   // binary supports: replay was refused (nothing truncated, nothing lost)

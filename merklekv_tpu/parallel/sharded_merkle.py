@@ -23,9 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from merklekv_tpu.merkle.jax_engine import build_levels_device
 from merklekv_tpu.merkle.diff import divergence_masks
-from merklekv_tpu.ops.sha256 import sha256_blocks
+from merklekv_tpu.ops.dispatch import build_levels, hash_blocks, use_pallas
 
 __all__ = [
     "sharded_tree_root",
@@ -36,8 +35,9 @@ __all__ = [
 
 
 def _local_root(block: jax.Array) -> jax.Array:
-    """[L, 8] -> [1, 8] subtree root (L is a power of two)."""
-    return build_levels_device(block)[-1]
+    """[L, 8] -> [1, 8] subtree root (L is a power of two). Node hashing is
+    backend-dispatched: Pallas kernels on TPU, scan elsewhere."""
+    return build_levels(block)[-1]
 
 
 def _check_local_block(l: int) -> None:
@@ -61,7 +61,9 @@ def _check_shardable(n: int, d: int, what: str = "leaf count") -> int:
 
 
 @lru_cache(maxsize=None)
-def _tree_root_program(mesh: Mesh, axis: str):
+def _tree_root_program(mesh: Mesh, axis: str, pallas: bool):
+    del pallas  # cache key only; the dispatch is re-read at trace time
+
     @partial(
         shard_map,
         mesh=mesh,
@@ -73,7 +75,7 @@ def _tree_root_program(mesh: Mesh, axis: str):
         _check_local_block(block.shape[0])
         local = _local_root(block)  # [1, 8]
         roots = jax.lax.all_gather(local, axis, axis=0, tiled=True)  # [D, 8]
-        return build_levels_device(roots)[-1]  # [1, 8], same on every shard
+        return build_levels(roots)[-1]  # [1, 8], same on every shard
 
     return jax.jit(go)
 
@@ -87,7 +89,7 @@ def sharded_tree_root(mesh: Mesh, leaves: jax.Array, axis: str = "key") -> jax.A
     cached per (mesh, axis, shapes).
     """
     _check_shardable(leaves.shape[0], mesh.shape[axis])
-    return _tree_root_program(mesh, axis)(leaves)[0]
+    return _tree_root_program(mesh, axis, use_pallas())(leaves)[0]
 
 
 def sharded_divergence(
@@ -124,8 +126,7 @@ def _divergence_program(mesh: Mesh, axis: str):
     return jax.jit(go)
 
 
-@lru_cache(maxsize=None)
-def make_anti_entropy_step(mesh: Mesh, axis: str = "key"):
+def make_anti_entropy_step(mesh: Mesh, axis: str = "key", pallas=None):
     """One fused SPMD anti-entropy program over a keyspace-sharded mesh.
 
     The full data-plane step of the framework (the analog of a training step):
@@ -148,7 +149,20 @@ def make_anti_entropy_step(mesh: Mesh, axis: str = "key"):
       present [R, N] bool
     Returns (root [8] uint32 replicated, masks [R, N] bool sharded over keys,
     counts [R] int32 replicated).
+
+    ``pallas`` keys the program cache on the SHA-256 backend; None (the
+    default) resolves the dispatch at CALL time — Pallas on TPU, scan
+    elsewhere — outside the cache, so an env flip between calls can never
+    replay a program compiled for the other formulation.
     """
+    return _anti_entropy_program(
+        mesh, axis, use_pallas() if pallas is None else pallas
+    )
+
+
+@lru_cache(maxsize=None)
+def _anti_entropy_program(mesh: Mesh, axis: str, pallas: bool):
+    del pallas  # cache key only; the dispatch is re-read at trace time
 
     @partial(
         shard_map,
@@ -159,10 +173,10 @@ def make_anti_entropy_step(mesh: Mesh, axis: str = "key"):
     )
     def step(blk, nb, dig, pres):
         _check_local_block(blk.shape[0])
-        leaves = sha256_blocks(blk, nb)
+        leaves = hash_blocks(blk, nb)  # Pallas on TPU, scan elsewhere
         local_root = _local_root(leaves)  # [1, 8]
         roots = jax.lax.all_gather(local_root, axis, axis=0, tiled=True)  # [D, 8]
-        root = build_levels_device(roots)[-1][0]  # [8]
+        root = build_levels(roots)[-1][0]  # [8]
         masks = divergence_masks(dig, pres)
         counts = jax.lax.psum(jnp.sum(masks, axis=1, dtype=jnp.int32), axis)
         return root, masks, counts
@@ -185,4 +199,6 @@ def sharded_anti_entropy_step(
         raise ValueError(
             f"digest key axis {digests.shape[1]} != leaf count {blocks.shape[0]}"
         )
-    return make_anti_entropy_step(mesh, axis)(blocks, nblocks, digests, present)
+    return make_anti_entropy_step(mesh, axis, use_pallas())(
+        blocks, nblocks, digests, present
+    )
