@@ -85,25 +85,38 @@ def _build_fn(capacity: int, pallas: bool):
     return go
 
 
+def _scatter_levels(levels: tuple, idx: jax.Array, new_leaves: jax.Array):
+    """Scatter new leaf digests + re-reduce only the touched parent paths.
+
+    idx [kb] int32 (padded entries duplicate a real entry with the
+    identical leaf value, so duplicate scatters are benign);
+    new_leaves [kb, 8] uint32."""
+    out = [levels[0].at[idx].set(new_leaves)]
+    cur_idx = idx
+    for lvl in range(1, len(levels)):
+        cur_idx = cur_idx // 2
+        left = out[-1][2 * cur_idx]
+        right = out[-1][2 * cur_idx + 1]
+        parents = hash_node_pairs(left, right)
+        out.append(levels[lvl].at[cur_idx].set(parents))
+    return tuple(out)
+
+
 @lru_cache(maxsize=None)
-def _scatter_update_fn(capacity: int, kb: int, pallas: bool):
-    """Compiled scatter + path re-reduction for (capacity, batch bucket)."""
+def _scatter_hash_fn(capacity: int, kb: int, nblk: int, pallas: bool):
+    """Fused leaf hashing + scatter + path re-reduction: ONE device program
+    per update batch. Separate hash-then-scatter calls each pay a host->
+    device dispatch round trip — through a tunneled backend that latency,
+    not the hashing, dominates sustained update throughput (BASELINE
+    config 4)."""
     del pallas
 
     @jax.jit
-    def go(levels: tuple, idx: jax.Array, new_leaves: jax.Array):
-        # idx [kb] int32 (padded entries duplicate a real entry with the
-        # identical leaf value, so duplicate scatters are benign);
-        # new_leaves [kb, 8] uint32.
-        out = [levels[0].at[idx].set(new_leaves)]
-        cur_idx = idx
-        for lvl in range(1, len(levels)):
-            cur_idx = cur_idx // 2
-            left = out[-1][2 * cur_idx]
-            right = out[-1][2 * cur_idx + 1]
-            parents = hash_node_pairs(left, right)
-            out.append(levels[lvl].at[cur_idx].set(parents))
-        return tuple(out)
+    def go(levels: tuple, idx: jax.Array, blocks: jax.Array,
+           nblocks: jax.Array):
+        from merklekv_tpu.ops.dispatch import hash_blocks
+
+        return _scatter_levels(levels, idx, hash_blocks(blocks, nblocks))
 
     return go
 
@@ -165,15 +178,53 @@ class DeviceMerkleState:
     Host side owns only the sorted key array (the authoritative KV store is
     the native engine). Device side owns ``levels``: levels[0] is [C, 8]
     leaf digests, levels[d] is [1, 8].
+
+    ``sharding`` (a ``NamedSharding`` whose spec shards dim 0, e.g.
+    ``P("key", None)``) places the leaf level across a device mesh; the
+    jitted build/scatter/restructure programs then run SPMD with XLA
+    inserting the collectives (GSPMD) — the serving-path integration of
+    SURVEY §2.4's keyspace sharding. Capacity is kept a multiple of the
+    mesh axis so the leaf dimension always divides evenly.
     """
 
     # Auto-flush ceiling: bounds the host memory pending values can hold.
     PENDING_LIMIT = 65536
 
-    def __init__(self) -> None:
+    def __init__(self, sharding=None) -> None:
         self._keys = np.empty(0, dtype=object)  # sorted key bytes
+        # key -> sorted position. np.searchsorted on an OBJECT array does a
+        # Python-level comparison per probe (~tens of ms per 32K-key batch
+        # against a 1M tree) and was the sustained-update bottleneck; dict
+        # lookups are O(1) C-level. Rebuilt on structural changes only.
+        self._index: dict[bytes, int] = {}
         self._levels: Optional[tuple[jax.Array, ...]] = None
         self._capacity = 0
+        self._sharding = sharding
+        if sharding is not None:
+            axis = sharding.spec[0]
+            if not isinstance(axis, str):
+                raise ValueError(
+                    "sharding must shard dim 0 on a named mesh axis"
+                )
+            self._n_shards = int(sharding.mesh.shape[axis])
+            if self._n_shards & (self._n_shards - 1):
+                # Capacity is a power of two (the padded-tree math depends
+                # on it), so only power-of-two shard counts divide the leaf
+                # dimension evenly. Callers with odd device counts should
+                # mesh a power-of-two subset (DeviceTreeMirror does).
+                raise ValueError(
+                    f"sharded tree needs a power-of-two shard count, "
+                    f"got {self._n_shards}"
+                )
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # Matching 1-D placement for per-slot index vectors.
+            self._sharding_1d = NamedSharding(
+                sharding.mesh, PartitionSpec(axis)
+            )
+        else:
+            self._n_shards = 1
+            self._sharding_1d = None
         # Writes accumulate here and flush as ONE device batch at the next
         # query (or at PENDING_LIMIT): a stream of N single-key applies
         # costs one restructure, not N — the amortization a per-write
@@ -186,9 +237,9 @@ class DeviceMerkleState:
     # ------------------------------------------------------------ loading
     @classmethod
     def from_items(
-        cls, items: Iterable[tuple[bytes, bytes]]
+        cls, items: Iterable[tuple[bytes, bytes]], sharding=None
     ) -> "DeviceMerkleState":
-        st = cls()
+        st = cls(sharding=sharding)
         dedup = dict(items)
         if dedup:
             ordered = sorted(dedup.items())
@@ -205,17 +256,18 @@ class DeviceMerkleState:
     # ------------------------------------------------------------ lookups
     def _find(self, key: bytes) -> int:
         """Position of key in the sorted array, or -1."""
-        i = int(np.searchsorted(self._keys, np.array(key, dtype=object)))
-        if i < len(self._keys) and self._keys[i] == key:
-            return i
-        return -1
+        return self._index.get(key, -1)
 
     def _positions(self, keys: Sequence[bytes]) -> np.ndarray:
         """Sorted-array positions for keys known to be present."""
-        if not len(self._keys):
-            return np.empty(0, np.int32)
-        arr = np.array(list(keys), dtype=object)
-        return np.searchsorted(self._keys, arr).astype(np.int32)
+        idx = self._index
+        return np.fromiter(
+            (idx[k] for k in keys), dtype=np.int32, count=len(keys)
+        )
+
+    def _set_keys(self, keys_arr: np.ndarray) -> None:
+        self._keys = keys_arr
+        self._index = {k: i for i, k in enumerate(keys_arr)}
 
     # ------------------------------------------------------------ updates
     def apply(self, changes: Sequence[tuple[bytes, Optional[bytes]]]) -> None:
@@ -232,21 +284,11 @@ class DeviceMerkleState:
             return
         pending, self._pending = self._pending, {}
 
-        # One vectorized membership pass classifies the whole batch.
-        keys = np.array(sorted(pending), dtype=object)
-        if len(self._keys):
-            pos = np.searchsorted(self._keys, keys)
-            clipped = np.clip(pos, 0, len(self._keys) - 1)
-            present = self._keys[clipped] == keys
-        else:
-            present = np.zeros(len(keys), bool)
-
-        deletes = [
-            k for k, p in zip(keys, present) if p and pending[k] is None
-        ]
-        inserts = [
-            k for k, p in zip(keys, present) if not p and pending[k] is not None
-        ]
+        # One membership pass (O(1) dict probes) classifies the whole batch.
+        keys = sorted(pending)
+        idx = self._index
+        deletes = [k for k in keys if k in idx and pending[k] is None]
+        inserts = [k for k in keys if k not in idx and pending[k] is not None]
         upserts = {k: v for k, v in pending.items() if v is not None}
 
         if not deletes and not inserts:
@@ -257,29 +299,56 @@ class DeviceMerkleState:
         self._restructure(deletes, upserts, inserts)
 
     def _update_in_place(self, items: list[tuple[bytes, bytes]]) -> None:
+        from merklekv_tpu.merkle.packing import pack_leaves
+
         k = len(items)
         kb = _bucket(k)
         idx = np.empty(kb, np.int32)
         idx[:k] = self._positions([key for key, _ in items])
         idx[k:] = idx[0]  # pad with a duplicate of a real entry
-        digests = leaf_digests([key for key, _ in items],
-                               [v for _, v in items])
-        new_leaves = jnp.concatenate(
-            [digests, jnp.broadcast_to(digests[0], (kb - k, 8))], axis=0
-        ) if kb > k else digests
-        fn = _scatter_update_fn(self._capacity, kb, use_pallas())
-        self._levels = fn(self._levels, jnp.asarray(idx), new_leaves)
+        packed = pack_leaves([key for key, _ in items], [v for _, v in items])
+        # Pad rows by duplicating row 0 (same digest as idx[0] — duplicate
+        # scatters write identical values). The block axis stays EXACT (one
+        # compile per distinct max_blocks — bounded by value sizes in
+        # practice): rounding it up doubles the host->device transfer,
+        # which is the sustained-update bottleneck on a tunneled backend.
+        nblk = packed.max_blocks
+        blocks = np.zeros((kb, nblk, 16), np.uint32)
+        blocks[:k, : packed.max_blocks] = packed.blocks
+        nblocks = np.empty(kb, np.int32)
+        nblocks[:k] = packed.nblocks
+        if kb > k:
+            blocks[k:] = blocks[0]
+            nblocks[k:] = nblocks[0]
+        fn = _scatter_hash_fn(self._capacity, kb, nblk, use_pallas())
+        self._levels = fn(
+            self._levels, jnp.asarray(idx), jnp.asarray(blocks),
+            jnp.asarray(nblocks),
+        )
         self.incremental_batches += 1
 
     # ------------------------------------------------------------ structure
+    def _capacity_for(self, n: int) -> int:
+        # Sharded trees keep C a multiple of the mesh axis so the leaf
+        # dimension always divides evenly across devices.
+        return max(_next_pow2(n), self._n_shards)
+
+    def _put(self, arr: np.ndarray, one_d: bool = False) -> jax.Array:
+        """Host array -> device, honoring the keyspace sharding if set."""
+        if self._sharding is None:
+            return jnp.asarray(arr)
+        return jax.device_put(
+            arr, self._sharding_1d if one_d else self._sharding
+        )
+
     def _initial_build(self, keys_arr: np.ndarray, values: list) -> None:
         n = len(keys_arr)
-        c = _next_pow2(n)
+        c = self._capacity_for(n)
         digests = np.asarray(leaf_digests(list(keys_arr), values))
         padded = np.zeros((c, 8), np.uint32)
         padded[:n] = digests
-        self._levels = _build_fn(c, use_pallas())(jnp.asarray(padded))
-        self._keys = keys_arr
+        self._levels = _build_fn(c, use_pallas())(self._put(padded))
+        self._set_keys(keys_arr)
         self._capacity = c
         self.full_rebuilds += 1
 
@@ -310,7 +379,7 @@ class DeviceMerkleState:
             gather = surv_src
         n_new = len(new_keys)
         if n_new == 0:
-            self._keys = np.empty(0, dtype=object)
+            self._set_keys(np.empty(0, dtype=object))
             self._levels = None
             self._capacity = 0
             return
@@ -322,7 +391,7 @@ class DeviceMerkleState:
             )
             return
 
-        c_new = _next_pow2(n_new)
+        c_new = self._capacity_for(n_new)
         gather_padded = np.full(c_new, -1, np.int32)
         gather_padded[:n_new] = gather
 
@@ -347,10 +416,10 @@ class DeviceMerkleState:
 
         fn = _restructure_fn(self._capacity, c_new, kb, use_pallas())
         self._levels = fn(
-            self._levels[0], jnp.asarray(gather_padded),
+            self._levels[0], self._put(gather_padded, one_d=True),
             jnp.asarray(fresh_pos), fresh,
         )
-        self._keys = new_keys
+        self._set_keys(new_keys)
         self._capacity = c_new
         self.structural_batches += 1
 

@@ -65,7 +65,7 @@ def test_production_paths_route_through_dispatch(monkeypatch):
     # Fresh compiled-program cache entries for the forced backend: the
     # factories key on use_pallas(), so these traces re-read the dispatch.
     incremental._build_fn.cache_clear()
-    incremental._scatter_update_fn.cache_clear()
+    incremental._scatter_hash_fn.cache_clear()
     incremental._restructure_fn.cache_clear()
 
     items = {b"rk%03d" % i: b"rv%d" % i for i in range(21)}
@@ -90,7 +90,7 @@ def test_production_paths_route_through_dispatch(monkeypatch):
 
     # Cleanup: drop the spy-traced programs so later tests re-trace real ones.
     incremental._build_fn.cache_clear()
-    incremental._scatter_update_fn.cache_clear()
+    incremental._scatter_hash_fn.cache_clear()
     incremental._restructure_fn.cache_clear()
 
 
