@@ -25,8 +25,12 @@ __all__ = ["DeviceTreeMirror"]
 
 
 class DeviceTreeMirror:
-    def __init__(self, engine: NativeEngine) -> None:
+    def __init__(self, engine: NativeEngine, sharded: bool = False) -> None:
         self._engine = engine
+        # Shard the device tree's leaf level over ALL local JAX devices
+        # (GSPMD over a "key" mesh) instead of living on one chip — the
+        # serving-path integration of the SPMD program (SURVEY §2.4).
+        self._sharded = sharded
         self._mu = threading.RLock()
         self._state = None  # lazy: built from an engine snapshot on first use
         self._warming = threading.Event()
@@ -84,7 +88,7 @@ class DeviceTreeMirror:
                         self._pending_truncate = False
                         items = self._engine.snapshot()
                     cls = self._device_state_cls()
-                    st = cls.from_items(items)
+                    st = cls.from_items(items, sharding=self._make_sharding())
                     # Pay the build + kernel-compile cost HERE so the first
                     # post-warm HASH answers immediately.
                     st.root_hex()
@@ -192,8 +196,27 @@ class DeviceTreeMirror:
 
         return DeviceMerkleState
 
+    def _make_sharding(self):
+        """NamedSharding over local devices ("key" mesh) when sharded
+        serving is on; None for the single-device tree. Non-power-of-two
+        device counts mesh the largest power-of-two subset — the padded
+        tree's capacity is a power of two and must divide evenly."""
+        if not self._sharded:
+            return None
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from merklekv_tpu.parallel.mesh import make_mesh
+
+        devs = jax.devices()
+        n = 1 << (len(devs).bit_length() - 1)  # largest pow2 <= len(devs)
+        mesh = make_mesh({"key": n}, devices=devs[:n])
+        return NamedSharding(mesh, PartitionSpec("key", None))
+
     def _load_state(self):
-        return self._device_state_cls().from_items(self._engine.snapshot())
+        return self._device_state_cls().from_items(
+            self._engine.snapshot(), sharding=self._make_sharding()
+        )
 
     def _empty_state(self):
-        return self._device_state_cls()()
+        return self._device_state_cls()(sharding=self._make_sharding())

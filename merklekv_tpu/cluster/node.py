@@ -74,7 +74,14 @@ class ClusterNode:
     def _get_transport(self) -> Transport:
         if self._transport is None:
             rep = self._cfg.replication
-            self._transport = make_transport(rep.mqtt_broker, rep.mqtt_port)
+            self._transport = make_transport(
+                rep.mqtt_broker,
+                rep.mqtt_port,
+                kind=rep.transport,
+                client_id=rep.client_id,
+                username=rep.username,
+                password=rep.password,
+            )
         return self._transport
 
     def _enable_replication(self) -> Optional[str]:
@@ -92,7 +99,10 @@ class ClusterNode:
             if self._cfg.anti_entropy.engine != "cpu":
                 from merklekv_tpu.cluster.mirror import DeviceTreeMirror
 
-                self._mirror = DeviceTreeMirror(self._engine)
+                self._mirror = DeviceTreeMirror(
+                    self._engine,
+                    sharded=self._cfg.device.sharded_mirror,
+                )
             self._replicator = Replicator(
                 self._engine,
                 self._server,

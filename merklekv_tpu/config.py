@@ -18,10 +18,14 @@ from typing import Optional
 @dataclass
 class ReplicationConfig:
     enabled: bool = False
-    # MQTT-style broker endpoint for WAN replication; "local" selects the
-    # in-process event bus (tests / single-host clusters).
+    # Broker endpoint for WAN replication; "local" selects the in-process
+    # event bus (tests / single-host clusters).
     mqtt_broker: str = "localhost"
     mqtt_port: int = 1883
+    # "framed": the self-hosted length-framed TcpBroker (default fabric).
+    # "mqtt": real MQTT 3.1.1 frames — joins an existing mosquitto-style
+    # deployment, like the reference (replication.rs:115-143).
+    transport: str = "framed"
     topic_prefix: str = "merkle_kv"
     client_id: str = ""
     username: str = ""
@@ -47,6 +51,14 @@ class AntiEntropyConfig:
 
 
 @dataclass
+class DeviceConfig:
+    # Shard the serving Merkle tree's leaf level over ALL local JAX devices
+    # (GSPMD over a "key" mesh). Single-device trees are the default; on a
+    # multi-chip host this spreads HBM and the rebuild across chips.
+    sharded_mirror: bool = False
+
+
+@dataclass
 class Config:
     host: str = "127.0.0.1"
     port: int = 7379
@@ -55,6 +67,7 @@ class Config:
     sync_interval_seconds: float = 60.0
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+    device: DeviceConfig = field(default_factory=DeviceConfig)
 
     @classmethod
     def load(cls, path: str) -> "Config":
@@ -79,8 +92,8 @@ class Config:
             if "interval_seconds" not in ae:
                 cfg.anti_entropy.interval_seconds = cfg.sync_interval_seconds
         rep = raw.get("replication", {})
-        for k in ("mqtt_broker", "topic_prefix", "client_id", "username",
-                  "password"):
+        for k in ("mqtt_broker", "transport", "topic_prefix", "client_id",
+                  "username", "password"):
             if k in rep:
                 setattr(cfg.replication, k, str(rep[k]))
         if "enabled" in rep:
@@ -99,6 +112,9 @@ class Config:
             cfg.anti_entropy.engine = str(ae["engine"])
         if "multi_peer" in ae:
             cfg.anti_entropy.multi_peer = bool(ae["multi_peer"])
+        dev = raw.get("device", {})
+        if "sharded_mirror" in dev:
+            cfg.device.sharded_mirror = bool(dev["sharded_mirror"])
         cfg.replication.resolve_env()
         return cfg
 

@@ -1,0 +1,150 @@
+"""Keyspace-sharded SERVING path (VERDICT r4 item 4).
+
+The SPMD program is no longer a standalone demo: DeviceMerkleState accepts a
+NamedSharding that places the leaf level across the device mesh (GSPMD
+inserts the collectives), DeviceTreeMirror/ClusterNode expose it via
+[device] sharded_mirror, and HASH on a multi-device host serves a root from
+the sharded tree bit-equal to the single-device/native one. These tests run
+on the virtual 8-device CPU mesh (conftest).
+"""
+
+import time
+import uuid
+
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.cluster.node import ClusterNode
+from merklekv_tpu.cluster.transport import TcpBroker
+from merklekv_tpu.config import Config
+from merklekv_tpu.merkle.cpu import build_levels
+from merklekv_tpu.merkle.encoding import leaf_hash
+from merklekv_tpu.merkle.incremental import DeviceMerkleState
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+from merklekv_tpu.parallel.mesh import make_mesh
+
+
+def _golden_root(items: dict[bytes, bytes]) -> str:
+    if not items:
+        return "0" * 64
+    hashes = [leaf_hash(k, v) for k, v in sorted(items.items())]
+    return build_levels(hashes)[-1][0].hex()
+
+
+@pytest.fixture
+def sharding():
+    return NamedSharding(make_mesh(), P("key", None))
+
+
+def test_sharded_state_build_parity(sharding):
+    items = {b"sb%04d" % i: b"val%d" % i for i in range(100)}
+    st = DeviceMerkleState.from_items(items.items(), sharding=sharding)
+    assert st.root_hex() == _golden_root(items)
+    # The leaf level really is laid out across the mesh.
+    leaf_sharding = st._levels[0].sharding
+    assert not leaf_sharding.is_fully_replicated
+
+
+def test_sharded_state_mutations_parity(sharding):
+    items = {b"sm%04d" % i: b"v%d" % i for i in range(65)}
+    st = DeviceMerkleState.from_items(items.items(), sharding=sharding)
+
+    # Scatter path (values only).
+    for i in range(9):
+        items[b"sm%04d" % i] = b"upd%d" % i
+    st.apply([(b"sm%04d" % i, b"upd%d" % i) for i in range(9)])
+    assert st.root_hex() == _golden_root(items)
+    assert st.incremental_batches >= 1
+
+    # Restructure path (inserts + deletes, capacity growth across shards).
+    for i in range(200, 300):
+        items[b"sm%04d" % i] = b"new%d" % i
+    del items[b"sm0007"]
+    changes = [(b"sm%04d" % i, b"new%d" % i) for i in range(200, 300)]
+    changes.append((b"sm0007", None))
+    st.apply(changes)
+    assert st.root_hex() == _golden_root(items)
+    assert st.structural_batches >= 1
+
+
+def test_sharded_state_small_keyspace(sharding):
+    """n < number of devices: capacity is padded up to the mesh axis."""
+    items = {b"tiny1": b"a", b"tiny2": b"b"}
+    st = DeviceMerkleState.from_items(items.items(), sharding=sharding)
+    assert st.root_hex() == _golden_root(items)
+    assert st._capacity >= 8  # mesh axis size
+
+    # Drain to empty and refill.
+    st.apply([(b"tiny1", None), (b"tiny2", None)])
+    assert st.root_hex() == "0" * 64
+    st.apply([(b"back", b"again")])
+    assert st.root_hex() == _golden_root({b"back": b"again"})
+
+
+def test_cluster_node_serves_sharded_root():
+    """End-to-end: a ClusterNode with [device] sharded_mirror serves HASH
+    from the mesh-sharded tree, bit-equal to the native CPU root."""
+    broker = TcpBroker()
+    engine = NativeEngine("mem")
+    server = NativeServer(engine, "127.0.0.1", 0)
+    server.start()
+    cfg = Config()
+    cfg.replication.enabled = True
+    cfg.replication.mqtt_broker = broker.host
+    cfg.replication.mqtt_port = broker.port
+    cfg.replication.topic_prefix = f"shard-{uuid.uuid4().hex[:8]}"
+    cfg.replication.client_id = "sh1"
+    cfg.device.sharded_mirror = True
+    node = ClusterNode(cfg, engine, server)
+    node.start()
+    client = MerkleKVClient("127.0.0.1", server.port, timeout=30.0).connect()
+    try:
+        for i in range(48):
+            client.set(f"shk{i:03d}", f"shv{i}")
+        native_root = engine.merkle_root().hex()
+        assert client.hash() == native_root  # native path while cold
+        client.hash()  # trigger warming
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if node._mirror is not None and node._mirror.ready():
+                break
+            time.sleep(0.02)
+        assert node._mirror.ready(), "sharded mirror never warmed"
+        # Warm path: served from the SHARDED device tree.
+        assert node.device_root_hex() == native_root
+        assert client.hash() == native_root
+        leaf_sharding = node._mirror.state._levels[0].sharding
+        assert not leaf_sharding.is_fully_replicated
+        # Writes keep flowing through the sharded incremental path.
+        client.set("shk000", "updated")
+        assert client.hash() == engine.merkle_root().hex()
+    finally:
+        client.close()
+        node.stop()
+        server.close()
+        engine.close()
+        broker.close()
+
+
+def test_config_parses_device_table(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text("[device]\nsharded_mirror = true\n")
+    assert Config.load(str(p)).device.sharded_mirror
+    assert not Config().device.sharded_mirror
+
+
+def test_non_pow2_shard_count_rejected():
+    """Capacity is a power of two; a 3-way mesh can't divide it. The state
+    rejects it loudly (the mirror meshes a pow2 device subset instead)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import jax
+
+    devs = jax.devices()[:3]
+    mesh = jax.sharding.Mesh(np.array(devs), ("key",))
+    with pytest.raises(ValueError, match="power-of-two"):
+        DeviceMerkleState(sharding=NamedSharding(mesh, P("key", None)))
