@@ -1,0 +1,511 @@
+// Package merklekv is a Go client for the merklekv_tpu text protocol
+// (docs/PROTOCOL.md; same wire surface as the reference MerkleKV, so it
+// interoperates with either server).
+//
+// Design: context-aware API (deadlines via ctx), TCP_NODELAY, a buffered
+// reader shared by all calls, and an explicit Pipeline for batching. The
+// client is safe for concurrent use; calls serialize on an internal mutex
+// (one in-flight command per connection, like the protocol requires).
+package merklekv
+
+import (
+	"bufio"
+	"context"
+	"errors"
+	"fmt"
+	"net"
+	"os"
+	"strconv"
+	"strings"
+	"sync"
+	"time"
+)
+
+// ErrNotFound is returned by Get when the key does not exist.
+var ErrNotFound = errors.New("merklekv: key not found")
+
+// ServerError wraps an ERROR response from the server.
+type ServerError struct{ Msg string }
+
+func (e *ServerError) Error() string { return "merklekv: server error: " + e.Msg }
+
+// Client is a connection to one merklekv server.
+type Client struct {
+	mu      sync.Mutex
+	conn    net.Conn
+	r       *bufio.Reader
+	timeout time.Duration
+}
+
+// Options configures Dial.
+type Options struct {
+	// Timeout bounds each command round-trip (default 5s). Context
+	// deadlines, when tighter, win.
+	Timeout time.Duration
+}
+
+// DefaultAddr resolves host:port from MERKLEKV_HOST / MERKLEKV_PORT
+// (defaults 127.0.0.1:7379) — the same env override the other SDKs honor.
+func DefaultAddr() string {
+	host := os.Getenv("MERKLEKV_HOST")
+	if host == "" {
+		host = "127.0.0.1"
+	}
+	port := os.Getenv("MERKLEKV_PORT")
+	if port == "" {
+		port = "7379"
+	}
+	return net.JoinHostPort(host, port)
+}
+
+// Dial connects to addr ("host:port"; empty means DefaultAddr()).
+func Dial(ctx context.Context, addr string, opts *Options) (*Client, error) {
+	if addr == "" {
+		addr = DefaultAddr()
+	}
+	timeout := 5 * time.Second
+	if opts != nil && opts.Timeout > 0 {
+		timeout = opts.Timeout
+	}
+	d := net.Dialer{}
+	conn, err := d.DialContext(ctx, "tcp", addr)
+	if err != nil {
+		return nil, err
+	}
+	if tc, ok := conn.(*net.TCPConn); ok {
+		_ = tc.SetNoDelay(true)
+	}
+	return &Client{conn: conn, r: bufio.NewReader(conn), timeout: timeout}, nil
+}
+
+// Close shuts the connection down.
+func (c *Client) Close() error {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	return c.conn.Close()
+}
+
+func validate(parts ...string) error {
+	for _, p := range parts {
+		if strings.ContainsAny(p, "\r\n") {
+			return errors.New("merklekv: CR/LF forbidden in command arguments")
+		}
+	}
+	return nil
+}
+
+func (c *Client) deadline(ctx context.Context) time.Time {
+	dl := time.Now().Add(c.timeout)
+	if ctxDl, ok := ctx.Deadline(); ok && ctxDl.Before(dl) {
+		dl = ctxDl
+	}
+	return dl
+}
+
+// roundTrip sends one command line and reads `lines` response lines.
+func (c *Client) roundTrip(ctx context.Context, cmd string) (string, error) {
+	lines, err := c.roundTripMulti(ctx, cmd, func(first string) int { return 0 })
+	if err != nil {
+		return "", err
+	}
+	return lines[0], nil
+}
+
+// roundTripMulti sends cmd and reads 1 + extra(first) lines, where extra
+// inspects the first response line to decide how many more follow.
+func (c *Client) roundTripMulti(
+	ctx context.Context, cmd string, extra func(first string) int,
+) ([]string, error) {
+	if err := validate(cmd); err != nil {
+		return nil, err
+	}
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	if err := c.conn.SetDeadline(c.deadline(ctx)); err != nil {
+		return nil, err
+	}
+	if _, err := c.conn.Write([]byte(cmd + "\r\n")); err != nil {
+		return nil, err
+	}
+	first, err := c.readLine()
+	if err != nil {
+		return nil, err
+	}
+	if strings.HasPrefix(first, "ERROR ") {
+		return nil, &ServerError{Msg: first[len("ERROR "):]}
+	}
+	n := extra(first)
+	lines := make([]string, 0, 1+n)
+	lines = append(lines, first)
+	for i := 0; i < n; i++ {
+		l, err := c.readLine()
+		if err != nil {
+			return nil, err
+		}
+		lines = append(lines, l)
+	}
+	return lines, nil
+}
+
+func (c *Client) readLine() (string, error) {
+	l, err := c.r.ReadString('\n')
+	if err != nil {
+		return "", err
+	}
+	return strings.TrimRight(l, "\r\n"), nil
+}
+
+// readUntilEnd reads lines until a bare "END" (STATS / INFO / CLIENT LIST).
+func (c *Client) readUntilEnd() ([]string, error) {
+	var out []string
+	for {
+		l, err := c.readLine()
+		if err != nil {
+			return nil, err
+		}
+		if l == "END" {
+			return out, nil
+		}
+		out = append(out, l)
+	}
+}
+
+// --- basic ops -------------------------------------------------------------
+
+// Get returns the value for key, or ErrNotFound.
+func (c *Client) Get(ctx context.Context, key string) (string, error) {
+	resp, err := c.roundTrip(ctx, "GET "+key)
+	if err != nil {
+		return "", err
+	}
+	if resp == "NOT_FOUND" {
+		return "", ErrNotFound
+	}
+	if !strings.HasPrefix(resp, "VALUE ") {
+		return "", fmt.Errorf("merklekv: unexpected GET response %q", resp)
+	}
+	return resp[len("VALUE "):], nil
+}
+
+// Set stores value under key (value may contain spaces and tabs).
+func (c *Client) Set(ctx context.Context, key, value string) error {
+	resp, err := c.roundTrip(ctx, "SET "+key+" "+value)
+	if err != nil {
+		return err
+	}
+	if resp != "OK" {
+		return fmt.Errorf("merklekv: unexpected SET response %q", resp)
+	}
+	return nil
+}
+
+// Delete removes key; returns true if it existed.
+func (c *Client) Delete(ctx context.Context, key string) (bool, error) {
+	resp, err := c.roundTrip(ctx, "DEL "+key)
+	if err != nil {
+		return false, err
+	}
+	return resp == "DELETED", nil
+}
+
+// --- numeric / string ops --------------------------------------------------
+
+// Incr adds delta to the integer at key (created as delta when missing).
+func (c *Client) Incr(ctx context.Context, key string, delta int64) (int64, error) {
+	return c.numeric(ctx, "INC", key, delta)
+}
+
+// Decr subtracts delta from the integer at key.
+func (c *Client) Decr(ctx context.Context, key string, delta int64) (int64, error) {
+	return c.numeric(ctx, "DEC", key, delta)
+}
+
+func (c *Client) numeric(ctx context.Context, verb, key string, d int64) (int64, error) {
+	resp, err := c.roundTrip(ctx, fmt.Sprintf("%s %s %d", verb, key, d))
+	if err != nil {
+		return 0, err
+	}
+	if !strings.HasPrefix(resp, "VALUE ") {
+		return 0, fmt.Errorf("merklekv: unexpected %s response %q", verb, resp)
+	}
+	return strconv.ParseInt(resp[len("VALUE "):], 10, 64)
+}
+
+// Append appends value; returns the new value (created when missing).
+func (c *Client) Append(ctx context.Context, key, value string) (string, error) {
+	return c.splice(ctx, "APPEND", key, value)
+}
+
+// Prepend prepends value; returns the new value.
+func (c *Client) Prepend(ctx context.Context, key, value string) (string, error) {
+	return c.splice(ctx, "PREPEND", key, value)
+}
+
+func (c *Client) splice(ctx context.Context, verb, key, value string) (string, error) {
+	resp, err := c.roundTrip(ctx, verb+" "+key+" "+value)
+	if err != nil {
+		return "", err
+	}
+	if !strings.HasPrefix(resp, "VALUE ") {
+		return "", fmt.Errorf("merklekv: unexpected %s response %q", verb, resp)
+	}
+	return resp[len("VALUE "):], nil
+}
+
+// --- bulk / query ops ------------------------------------------------------
+
+// MGet fetches many keys at once; missing keys are absent from the map.
+func (c *Client) MGet(ctx context.Context, keys ...string) (map[string]string, error) {
+	if len(keys) == 0 {
+		return map[string]string{}, nil
+	}
+	lines, err := c.roundTripMulti(
+		ctx, "MGET "+strings.Join(keys, " "),
+		func(first string) int {
+			if first == "NOT_FOUND" {
+				return 0
+			}
+			// VALUES <found> is followed by one line per REQUESTED key.
+			return len(keys)
+		},
+	)
+	if err != nil {
+		return nil, err
+	}
+	out := make(map[string]string, len(keys))
+	if lines[0] == "NOT_FOUND" {
+		return out, nil
+	}
+	for _, l := range lines[1:] {
+		k, v, ok := strings.Cut(l, " ")
+		if !ok {
+			continue
+		}
+		if v != "NOT_FOUND" {
+			out[k] = v
+		}
+	}
+	return out, nil
+}
+
+// MSet stores many pairs in one command.
+func (c *Client) MSet(ctx context.Context, pairs map[string]string) error {
+	if len(pairs) == 0 {
+		return nil
+	}
+	parts := make([]string, 0, 2*len(pairs))
+	for k, v := range pairs {
+		if strings.ContainsAny(v, " \t") {
+			// MSET splits on whitespace runs; values with spaces need SET.
+			return errors.New("merklekv: MSET values must not contain whitespace")
+		}
+		parts = append(parts, k, v)
+	}
+	resp, err := c.roundTrip(ctx, "MSET "+strings.Join(parts, " "))
+	if err != nil {
+		return err
+	}
+	if resp != "OK" {
+		return fmt.Errorf("merklekv: unexpected MSET response %q", resp)
+	}
+	return nil
+}
+
+// Exists counts how many of the given keys exist.
+func (c *Client) Exists(ctx context.Context, keys ...string) (int, error) {
+	resp, err := c.roundTrip(ctx, "EXISTS "+strings.Join(keys, " "))
+	if err != nil {
+		return 0, err
+	}
+	if !strings.HasPrefix(resp, "EXISTS ") {
+		return 0, fmt.Errorf("merklekv: unexpected EXISTS response %q", resp)
+	}
+	return strconv.Atoi(resp[len("EXISTS "):])
+}
+
+// Scan lists keys with the given prefix ("" = all), sorted.
+func (c *Client) Scan(ctx context.Context, prefix string) ([]string, error) {
+	cmd := "SCAN"
+	if prefix != "" {
+		cmd += " " + prefix
+	}
+	lines, err := c.roundTripMulti(ctx, cmd, func(first string) int {
+		var n int
+		if _, err := fmt.Sscanf(first, "KEYS %d", &n); err != nil {
+			return 0
+		}
+		return n
+	})
+	if err != nil {
+		return nil, err
+	}
+	return lines[1:], nil
+}
+
+// DBSize returns the number of keys.
+func (c *Client) DBSize(ctx context.Context) (int64, error) {
+	resp, err := c.roundTrip(ctx, "DBSIZE")
+	if err != nil {
+		return 0, err
+	}
+	var n int64
+	if _, err := fmt.Sscanf(resp, "DBSIZE %d", &n); err != nil {
+		return 0, fmt.Errorf("merklekv: unexpected DBSIZE response %q", resp)
+	}
+	return n, nil
+}
+
+// Hash returns the hex SHA-256 Merkle root of the keyspace (64 zeros when
+// empty). A non-empty pattern prefix-filters the keyspace.
+func (c *Client) Hash(ctx context.Context, pattern string) (string, error) {
+	cmd := "HASH"
+	if pattern != "" {
+		cmd += " " + pattern
+	}
+	resp, err := c.roundTrip(ctx, cmd)
+	if err != nil {
+		return "", err
+	}
+	fields := strings.Fields(resp)
+	if len(fields) < 2 || fields[0] != "HASH" {
+		return "", fmt.Errorf("merklekv: unexpected HASH response %q", resp)
+	}
+	return fields[len(fields)-1], nil
+}
+
+// Truncate drops every key.
+func (c *Client) Truncate(ctx context.Context) error {
+	resp, err := c.roundTrip(ctx, "TRUNCATE")
+	if err != nil {
+		return err
+	}
+	if resp != "OK" {
+		return fmt.Errorf("merklekv: unexpected TRUNCATE response %q", resp)
+	}
+	return nil
+}
+
+// --- admin -----------------------------------------------------------------
+
+// Ping round-trips a message; returns the echoed text.
+func (c *Client) Ping(ctx context.Context, msg string) (string, error) {
+	cmd := "PING"
+	if msg != "" {
+		cmd += " " + msg
+	}
+	resp, err := c.roundTrip(ctx, cmd)
+	if err != nil {
+		return "", err
+	}
+	if !strings.HasPrefix(resp, "PONG") {
+		return "", fmt.Errorf("merklekv: unexpected PING response %q", resp)
+	}
+	return strings.TrimPrefix(strings.TrimPrefix(resp, "PONG"), " "), nil
+}
+
+// HealthCheck returns nil when the server answers PING.
+func (c *Client) HealthCheck(ctx context.Context) error {
+	_, err := c.Ping(ctx, "health")
+	return err
+}
+
+// Stats returns the server's STATS counters as a map.
+func (c *Client) Stats(ctx context.Context) (map[string]string, error) {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	if err := c.conn.SetDeadline(c.deadline(ctx)); err != nil {
+		return nil, err
+	}
+	if _, err := c.conn.Write([]byte("STATS\r\n")); err != nil {
+		return nil, err
+	}
+	first, err := c.readLine()
+	if err != nil {
+		return nil, err
+	}
+	if first != "STATS" {
+		return nil, fmt.Errorf("merklekv: unexpected STATS response %q", first)
+	}
+	lines, err := c.readUntilEnd()
+	if err != nil {
+		return nil, err
+	}
+	out := make(map[string]string, len(lines))
+	for _, l := range lines {
+		if k, v, ok := strings.Cut(l, ":"); ok {
+			out[k] = v
+		}
+	}
+	return out, nil
+}
+
+// Version returns the server version string.
+func (c *Client) Version(ctx context.Context) (string, error) {
+	resp, err := c.roundTrip(ctx, "VERSION")
+	if err != nil {
+		return "", err
+	}
+	return strings.TrimPrefix(resp, "VERSION "), nil
+}
+
+// --- pipeline --------------------------------------------------------------
+
+// Pipeline batches commands into one write and reads all responses at once
+// (single-line-response commands only: SET/GET/DEL/INC/DEC/APPEND/PREPEND).
+type Pipeline struct {
+	c    *Client
+	cmds []string
+}
+
+// Pipeline starts an empty pipeline bound to this client.
+func (c *Client) Pipeline() *Pipeline { return &Pipeline{c: c} }
+
+func (p *Pipeline) Set(key, value string) *Pipeline {
+	p.cmds = append(p.cmds, "SET "+key+" "+value)
+	return p
+}
+
+func (p *Pipeline) Get(key string) *Pipeline {
+	p.cmds = append(p.cmds, "GET "+key)
+	return p
+}
+
+func (p *Pipeline) Delete(key string) *Pipeline {
+	p.cmds = append(p.cmds, "DEL "+key)
+	return p
+}
+
+// Exec sends every queued command in one write and returns the raw
+// response line for each, in order.
+func (p *Pipeline) Exec(ctx context.Context) ([]string, error) {
+	if len(p.cmds) == 0 {
+		return nil, nil
+	}
+	if err := validate(p.cmds...); err != nil {
+		return nil, err
+	}
+	c := p.c
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	if err := c.conn.SetDeadline(c.deadline(ctx)); err != nil {
+		return nil, err
+	}
+	var sb strings.Builder
+	for _, cmd := range p.cmds {
+		sb.WriteString(cmd)
+		sb.WriteString("\r\n")
+	}
+	if _, err := c.conn.Write([]byte(sb.String())); err != nil {
+		return nil, err
+	}
+	out := make([]string, 0, len(p.cmds))
+	for range p.cmds {
+		l, err := c.readLine()
+		if err != nil {
+			return nil, err
+		}
+		out = append(out, l)
+	}
+	p.cmds = p.cmds[:0]
+	return out, nil
+}

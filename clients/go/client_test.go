@@ -1,0 +1,166 @@
+package merklekv
+
+// Integration tests against a live server. CI starts one (native binary or
+// `python -m merklekv_tpu`) and exports MERKLEKV_PORT; without a reachable
+// server the suite skips rather than fails.
+
+import (
+	"context"
+	"errors"
+	"fmt"
+	"strings"
+	"testing"
+	"time"
+)
+
+func dialOrSkip(t *testing.T) *Client {
+	t.Helper()
+	ctx, cancel := context.WithTimeout(context.Background(), 2*time.Second)
+	defer cancel()
+	c, err := Dial(ctx, "", nil)
+	if err != nil {
+		t.Skipf("no server at %s: %v", DefaultAddr(), err)
+	}
+	t.Cleanup(func() { c.Close() })
+	return c
+}
+
+func ctx(t *testing.T) context.Context {
+	c, cancel := context.WithTimeout(context.Background(), 10*time.Second)
+	t.Cleanup(cancel)
+	return c
+}
+
+func TestSetGetDelete(t *testing.T) {
+	c := dialOrSkip(t)
+	if err := c.Set(ctx(t), "go:k1", "v1"); err != nil {
+		t.Fatal(err)
+	}
+	v, err := c.Get(ctx(t), "go:k1")
+	if err != nil || v != "v1" {
+		t.Fatalf("get = %q, %v", v, err)
+	}
+	existed, err := c.Delete(ctx(t), "go:k1")
+	if err != nil || !existed {
+		t.Fatalf("delete = %v, %v", existed, err)
+	}
+	if _, err := c.Get(ctx(t), "go:k1"); !errors.Is(err, ErrNotFound) {
+		t.Fatalf("want ErrNotFound, got %v", err)
+	}
+}
+
+func TestValuesWithSpaces(t *testing.T) {
+	c := dialOrSkip(t)
+	val := "hello world\twith tab"
+	if err := c.Set(ctx(t), "go:spaces", val); err != nil {
+		t.Fatal(err)
+	}
+	got, err := c.Get(ctx(t), "go:spaces")
+	if err != nil || got != val {
+		t.Fatalf("get = %q, %v", got, err)
+	}
+}
+
+func TestNumericAndSplice(t *testing.T) {
+	c := dialOrSkip(t)
+	_, _ = c.Delete(ctx(t), "go:n")
+	n, err := c.Incr(ctx(t), "go:n", 5)
+	if err != nil || n != 5 {
+		t.Fatalf("incr = %d, %v", n, err)
+	}
+	n, err = c.Decr(ctx(t), "go:n", 2)
+	if err != nil || n != 3 {
+		t.Fatalf("decr = %d, %v", n, err)
+	}
+	_, _ = c.Delete(ctx(t), "go:s")
+	s, err := c.Append(ctx(t), "go:s", "ab")
+	if err != nil || s != "ab" {
+		t.Fatalf("append = %q, %v", s, err)
+	}
+	s, err = c.Prepend(ctx(t), "go:s", "x")
+	if err != nil || s != "xab" {
+		t.Fatalf("prepend = %q, %v", s, err)
+	}
+}
+
+func TestMGetMSetScanExists(t *testing.T) {
+	c := dialOrSkip(t)
+	if err := c.MSet(ctx(t), map[string]string{
+		"go:m1": "a", "go:m2": "b",
+	}); err != nil {
+		t.Fatal(err)
+	}
+	got, err := c.MGet(ctx(t), "go:m1", "go:m2", "go:absent")
+	if err != nil {
+		t.Fatal(err)
+	}
+	if got["go:m1"] != "a" || got["go:m2"] != "b" {
+		t.Fatalf("mget = %v", got)
+	}
+	if _, ok := got["go:absent"]; ok {
+		t.Fatalf("absent key present: %v", got)
+	}
+	n, err := c.Exists(ctx(t), "go:m1", "go:m2", "go:absent")
+	if err != nil || n != 2 {
+		t.Fatalf("exists = %d, %v", n, err)
+	}
+	keys, err := c.Scan(ctx(t), "go:m")
+	if err != nil || len(keys) != 2 || keys[0] != "go:m1" {
+		t.Fatalf("scan = %v, %v", keys, err)
+	}
+}
+
+func TestHashChangesWithWrites(t *testing.T) {
+	c := dialOrSkip(t)
+	h1, err := c.Hash(ctx(t), "")
+	if err != nil || len(h1) != 64 {
+		t.Fatalf("hash = %q, %v", h1, err)
+	}
+	if err := c.Set(ctx(t), "go:hashkey", fmt.Sprint(time.Now().UnixNano())); err != nil {
+		t.Fatal(err)
+	}
+	h2, err := c.Hash(ctx(t), "")
+	if err != nil || h2 == h1 {
+		t.Fatalf("root unchanged after write: %q, %v", h2, err)
+	}
+}
+
+func TestPipeline(t *testing.T) {
+	c := dialOrSkip(t)
+	resps, err := c.Pipeline().
+		Set("go:p1", "1").
+		Set("go:p2", "2").
+		Get("go:p1").
+		Delete("go:p2").
+		Exec(ctx(t))
+	if err != nil {
+		t.Fatal(err)
+	}
+	want := []string{"OK", "OK", "VALUE 1", "DELETED"}
+	if len(resps) != len(want) {
+		t.Fatalf("resps = %v", resps)
+	}
+	for i := range want {
+		if resps[i] != want[i] {
+			t.Fatalf("resp[%d] = %q, want %q", i, resps[i], want[i])
+		}
+	}
+}
+
+func TestStatsAndHealth(t *testing.T) {
+	c := dialOrSkip(t)
+	if err := c.HealthCheck(ctx(t)); err != nil {
+		t.Fatal(err)
+	}
+	stats, err := c.Stats(ctx(t))
+	if err != nil {
+		t.Fatal(err)
+	}
+	if _, ok := stats["total_commands"]; !ok {
+		t.Fatalf("stats missing total_commands: %v", stats)
+	}
+	v, err := c.Version(ctx(t))
+	if err != nil || !strings.Contains(v, ".") {
+		t.Fatalf("version = %q, %v", v, err)
+	}
+}

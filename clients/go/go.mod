@@ -1,0 +1,3 @@
+module github.com/merklekv/merklekv-tpu/clients/go
+
+go 1.21

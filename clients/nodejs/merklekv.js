@@ -1,0 +1,363 @@
+/**
+ * Node.js client for the merklekv_tpu text protocol (docs/PROTOCOL.md; same
+ * wire surface as the reference MerkleKV, so it works against either
+ * server). Zero dependencies; promise-based; commands serialize on one
+ * connection via an internal queue (the protocol is strictly
+ * request/response per connection). Pipelines batch many commands into one
+ * write.
+ */
+
+"use strict";
+
+const net = require("net");
+
+class NotFoundError extends Error {
+  constructor(key) {
+    super(`key not found: ${key}`);
+    this.name = "NotFoundError";
+  }
+}
+
+class ServerError extends Error {
+  constructor(msg) {
+    super(msg);
+    this.name = "ServerError";
+  }
+}
+
+function defaultAddr() {
+  return {
+    host: process.env.MERKLEKV_HOST || "127.0.0.1",
+    port: parseInt(process.env.MERKLEKV_PORT || "7379", 10),
+  };
+}
+
+function checkArg(s) {
+  if (/[\r\n]/.test(s)) {
+    throw new Error("CR/LF forbidden in command arguments");
+  }
+}
+
+class MerkleKVClient {
+  /**
+   * @param {object} [opts] {host, port, timeoutMs}
+   */
+  constructor(opts = {}) {
+    const d = defaultAddr();
+    this.host = opts.host || d.host;
+    this.port = opts.port || d.port;
+    this.timeoutMs = opts.timeoutMs || 5000;
+    this._sock = null;
+    this._buf = "";
+    this._waiters = []; // FIFO of line-consumers
+    this._queue = Promise.resolve(); // serializes commands
+  }
+
+  connect() {
+    return new Promise((resolve, reject) => {
+      const sock = net.createConnection(
+        { host: this.host, port: this.port },
+        () => {
+          sock.setNoDelay(true);
+          // The connect timeout must not become a permanent inactivity
+          // timer: an idle-but-healthy connection would be destroyed.
+          // Commands arm their own per-call timers (_withTimeout).
+          sock.setTimeout(0);
+          this._sock = sock;
+          resolve(this);
+        }
+      );
+      sock.setTimeout(this.timeoutMs, () => {
+        const err = new Error(`timed out after ${this.timeoutMs}ms`);
+        sock.destroy(err);
+      });
+      sock.on("error", (err) => {
+        if (!this._sock) reject(err);
+        for (const w of this._waiters.splice(0)) w.reject(err);
+      });
+      sock.on("close", () => {
+        const err = new Error("connection closed");
+        for (const w of this._waiters.splice(0)) w.reject(err);
+      });
+      sock.on("data", (chunk) => {
+        this._buf += chunk.toString("utf8");
+        let idx;
+        while ((idx = this._buf.indexOf("\n")) >= 0 && this._waiters.length) {
+          const line = this._buf.slice(0, idx).replace(/\r$/, "");
+          this._buf = this._buf.slice(idx + 1);
+          this._waiters.shift().resolve(line);
+        }
+      });
+    });
+  }
+
+  close() {
+    if (this._sock) {
+      this._sock.destroy();
+      this._sock = null;
+    }
+  }
+
+  _readLine() {
+    // A buffered line may already be waiting.
+    const idx = this._buf.indexOf("\n");
+    if (idx >= 0) {
+      const line = this._buf.slice(0, idx).replace(/\r$/, "");
+      this._buf = this._buf.slice(idx + 1);
+      return Promise.resolve(line);
+    }
+    return new Promise((resolve, reject) => {
+      this._waiters.push({ resolve, reject });
+    });
+  }
+
+  /** Per-command deadline: destroys the connection on expiry (a stuck
+   * in-flight command leaves the stream unusable anyway — same policy as
+   * the Go client's SetDeadline). */
+  _withTimeout(promise) {
+    let timer;
+    const deadline = new Promise((_, reject) => {
+      timer = setTimeout(() => {
+        const err = new Error(`timed out after ${this.timeoutMs}ms`);
+        if (this._sock) this._sock.destroy(err);
+        reject(err);
+      }, this.timeoutMs);
+    });
+    return Promise.race([promise, deadline]).finally(() => clearTimeout(timer));
+  }
+
+  /** Send one command line, read one response line (ERROR -> throws). */
+  _command(line) {
+    checkArg(line);
+    const run = async () => {
+      if (!this._sock) throw new Error("not connected");
+      this._sock.write(line + "\r\n");
+      const resp = await this._readLine();
+      if (resp.startsWith("ERROR ")) throw new ServerError(resp.slice(6));
+      return resp;
+    };
+    const p = this._queue.then(
+      () => this._withTimeout(run()),
+      () => this._withTimeout(run())
+    );
+    // Keep the queue alive past failures.
+    this._queue = p.catch(() => {});
+    return p;
+  }
+
+  /** Send one command, read 1 + extra(first) lines. */
+  _commandMulti(line, extra) {
+    checkArg(line);
+    const run = async () => {
+      if (!this._sock) throw new Error("not connected");
+      this._sock.write(line + "\r\n");
+      const first = await this._readLine();
+      if (first.startsWith("ERROR ")) throw new ServerError(first.slice(6));
+      const lines = [first];
+      const n = extra(first);
+      for (let i = 0; i < n; i++) lines.push(await this._readLine());
+      return lines;
+    };
+    const p = this._queue.then(
+      () => this._withTimeout(run()),
+      () => this._withTimeout(run())
+    );
+    this._queue = p.catch(() => {});
+    return p;
+  }
+
+  // --- basic ---------------------------------------------------------------
+
+  /** @returns {Promise<string|null>} value, or null when missing */
+  async get(key) {
+    const resp = await this._command(`GET ${key}`);
+    if (resp === "NOT_FOUND") return null;
+    if (!resp.startsWith("VALUE ")) {
+      throw new ServerError(`unexpected GET response: ${resp}`);
+    }
+    return resp.slice(6);
+  }
+
+  async set(key, value) {
+    const resp = await this._command(`SET ${key} ${value}`);
+    if (resp !== "OK") throw new ServerError(`unexpected SET response: ${resp}`);
+  }
+
+  /** @returns {Promise<boolean>} true when the key existed */
+  async delete(key) {
+    return (await this._command(`DEL ${key}`)) === "DELETED";
+  }
+
+  // --- numeric / string ----------------------------------------------------
+
+  async incr(key, delta = 1) {
+    const resp = await this._command(`INC ${key} ${delta}`);
+    return parseInt(resp.slice(6), 10);
+  }
+
+  async decr(key, delta = 1) {
+    const resp = await this._command(`DEC ${key} ${delta}`);
+    return parseInt(resp.slice(6), 10);
+  }
+
+  async append(key, value) {
+    return (await this._command(`APPEND ${key} ${value}`)).slice(6);
+  }
+
+  async prepend(key, value) {
+    return (await this._command(`PREPEND ${key} ${value}`)).slice(6);
+  }
+
+  // --- bulk / query --------------------------------------------------------
+
+  /** @returns {Promise<Map<string,string>>} found keys only */
+  async mget(...keys) {
+    if (!keys.length) return new Map();
+    const lines = await this._commandMulti(
+      `MGET ${keys.join(" ")}`,
+      (first) => (first === "NOT_FOUND" ? 0 : keys.length)
+    );
+    const out = new Map();
+    if (lines[0] === "NOT_FOUND") return out;
+    for (const l of lines.slice(1)) {
+      const sp = l.indexOf(" ");
+      if (sp < 0) continue;
+      const k = l.slice(0, sp);
+      const v = l.slice(sp + 1);
+      if (v !== "NOT_FOUND") out.set(k, v);
+    }
+    return out;
+  }
+
+  async mset(pairs) {
+    const parts = [];
+    for (const [k, v] of Object.entries(pairs)) {
+      if (/\s/.test(v)) {
+        throw new Error("MSET values must not contain whitespace; use set()");
+      }
+      parts.push(k, v);
+    }
+    if (!parts.length) return;
+    const resp = await this._command(`MSET ${parts.join(" ")}`);
+    if (resp !== "OK") throw new ServerError(`unexpected MSET response: ${resp}`);
+  }
+
+  async exists(...keys) {
+    const resp = await this._command(`EXISTS ${keys.join(" ")}`);
+    return parseInt(resp.slice(7), 10);
+  }
+
+  /** @returns {Promise<string[]>} sorted keys with the prefix ("" = all) */
+  async scan(prefix = "") {
+    const cmd = prefix ? `SCAN ${prefix}` : "SCAN";
+    const lines = await this._commandMulti(cmd, (first) => {
+      const m = /^KEYS (\d+)$/.exec(first);
+      return m ? parseInt(m[1], 10) : 0;
+    });
+    return lines.slice(1);
+  }
+
+  async dbsize() {
+    const resp = await this._command("DBSIZE");
+    return parseInt(resp.slice(7), 10);
+  }
+
+  /** Hex SHA-256 Merkle root of the (prefix-filtered) keyspace. */
+  async hash(pattern = "") {
+    const cmd = pattern ? `HASH ${pattern}` : "HASH";
+    const resp = await this._command(cmd);
+    const fields = resp.split(" ");
+    if (fields[0] !== "HASH" || fields.length < 2) {
+      throw new ServerError(`unexpected HASH response: ${resp}`);
+    }
+    return fields[fields.length - 1];
+  }
+
+  async truncate() {
+    const resp = await this._command("TRUNCATE");
+    if (resp !== "OK") throw new ServerError(`unexpected TRUNCATE: ${resp}`);
+  }
+
+  // --- admin ---------------------------------------------------------------
+
+  async ping(msg = "") {
+    const resp = await this._command(msg ? `PING ${msg}` : "PING");
+    return resp.replace(/^PONG ?/, "");
+  }
+
+  async healthCheck() {
+    await this.ping("health");
+    return true;
+  }
+
+  /** @returns {Promise<Object<string,string>>} STATS counters */
+  async stats() {
+    const run = async () => {
+      this._sock.write("STATS\r\n");
+      const first = await this._readLine();
+      if (first !== "STATS") throw new ServerError(`unexpected: ${first}`);
+      const out = {};
+      for (;;) {
+        const l = await this._readLine();
+        if (l === "END") return out;
+        const c = l.indexOf(":");
+        if (c > 0) out[l.slice(0, c)] = l.slice(c + 1);
+      }
+    };
+    const p = this._queue.then(
+      () => this._withTimeout(run()),
+      () => this._withTimeout(run())
+    );
+    this._queue = p.catch(() => {});
+    return p;
+  }
+
+  async version() {
+    return (await this._command("VERSION")).replace(/^VERSION /, "");
+  }
+
+  // --- pipeline ------------------------------------------------------------
+
+  /** Batch single-line-response commands into one write. */
+  pipeline() {
+    const cmds = [];
+    const self = this;
+    const api = {
+      set(k, v) {
+        cmds.push(`SET ${k} ${v}`);
+        return api;
+      },
+      get(k) {
+        cmds.push(`GET ${k}`);
+        return api;
+      },
+      delete(k) {
+        cmds.push(`DEL ${k}`);
+        return api;
+      },
+      /** @returns {Promise<string[]>} raw response line per command */
+      exec() {
+        for (const c of cmds) checkArg(c);
+        const run = async () => {
+          if (!cmds.length) return [];
+          self._sock.write(cmds.map((c) => c + "\r\n").join(""));
+          const out = [];
+          for (let i = 0; i < cmds.length; i++) {
+            out.push(await self._readLine());
+          }
+          cmds.length = 0;
+          return out;
+        };
+        const p = self._queue.then(
+          () => self._withTimeout(run()),
+          () => self._withTimeout(run())
+        );
+        self._queue = p.catch(() => {});
+        return p;
+      },
+    };
+    return api;
+  }
+}
+
+module.exports = { MerkleKVClient, NotFoundError, ServerError, defaultAddr };

@@ -1,0 +1,107 @@
+// Integration tests (node --test) against a live server. CI starts one and
+// exports MERKLEKV_PORT; without a reachable server every test skips.
+"use strict";
+
+const assert = require("node:assert");
+const { test } = require("node:test");
+
+const { MerkleKVClient, defaultAddr } = require("./merklekv");
+
+async function connectOrSkip(t) {
+  const client = new MerkleKVClient({ timeoutMs: 10000 });
+  try {
+    await client.connect();
+  } catch (err) {
+    const { host, port } = defaultAddr();
+    t.skip(`no server at ${host}:${port}: ${err.message}`);
+    return null;
+  }
+  t.after(() => client.close());
+  return client;
+}
+
+test("set/get/delete round trip", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  await c.set("js:k1", "v1");
+  assert.strictEqual(await c.get("js:k1"), "v1");
+  assert.strictEqual(await c.delete("js:k1"), true);
+  assert.strictEqual(await c.get("js:k1"), null);
+  assert.strictEqual(await c.delete("js:k1"), false);
+});
+
+test("values with spaces and tabs", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  const val = "hello world\twith tab";
+  await c.set("js:spaces", val);
+  assert.strictEqual(await c.get("js:spaces"), val);
+});
+
+test("numeric and splice ops", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  await c.delete("js:n");
+  assert.strictEqual(await c.incr("js:n", 5), 5);
+  assert.strictEqual(await c.decr("js:n", 2), 3);
+  await c.delete("js:s");
+  assert.strictEqual(await c.append("js:s", "ab"), "ab");
+  assert.strictEqual(await c.prepend("js:s", "x"), "xab");
+});
+
+test("mget/mset/scan/exists", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  await c.mset({ "js:m1": "a", "js:m2": "b" });
+  const got = await c.mget("js:m1", "js:m2", "js:absent");
+  assert.strictEqual(got.get("js:m1"), "a");
+  assert.strictEqual(got.get("js:m2"), "b");
+  assert.strictEqual(got.has("js:absent"), false);
+  assert.strictEqual(await c.exists("js:m1", "js:m2", "js:absent"), 2);
+  const keys = await c.scan("js:m");
+  assert.deepStrictEqual(keys, ["js:m1", "js:m2"]);
+});
+
+test("hash changes with writes", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  const h1 = await c.hash();
+  assert.strictEqual(h1.length, 64);
+  await c.set("js:hashkey", String(Date.now()));
+  const h2 = await c.hash();
+  assert.notStrictEqual(h2, h1);
+});
+
+test("pipeline batches commands", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  const resps = await c
+    .pipeline()
+    .set("js:p1", "1")
+    .set("js:p2", "2")
+    .get("js:p1")
+    .delete("js:p2")
+    .exec();
+  assert.deepStrictEqual(resps, ["OK", "OK", "VALUE 1", "DELETED"]);
+});
+
+test("stats, health, version", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  assert.strictEqual(await c.healthCheck(), true);
+  const stats = await c.stats();
+  assert.ok("total_commands" in stats);
+  assert.ok((await c.version()).includes("."));
+});
+
+test("concurrent commands serialize correctly", async (t) => {
+  const c = await connectOrSkip(t);
+  if (!c) return;
+  const writes = [];
+  for (let i = 0; i < 32; i++) writes.push(c.set(`js:c${i}`, `v${i}`));
+  await Promise.all(writes);
+  const reads = [];
+  for (let i = 0; i < 32; i++) reads.push(c.get(`js:c${i}`));
+  const vals = await Promise.all(reads);
+  for (let i = 0; i < 32; i++) assert.strictEqual(vals[i], `v${i}`);
+});

@@ -1,0 +1,63 @@
+"""Go / Node.js SDK suites against a spawned native server.
+
+Each SDK carries its own test suite (clients/go/client_test.go,
+clients/nodejs/test.js); this harness spawns one embedded server and runs
+them with MERKLEKV_PORT pointed at it — the reference's clients-ci.yml
+pattern (/root/reference/.github/workflows/clients-ci.yml). Skipped when the
+toolchain isn't installed (this image has neither; CI does).
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def server_port():
+    engine = NativeEngine("mem")
+    server = NativeServer(engine, "127.0.0.1", 0)
+    server.start()
+    yield server.port
+    server.close()
+    engine.close()
+
+
+@pytest.mark.integration
+def test_go_client_suite(server_port):
+    go = shutil.which("go")
+    if go is None:
+        pytest.skip("go toolchain not installed")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [go, "test", "-v", "./..."],
+        cwd=os.path.join(REPO, "clients", "go"),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SKIP" not in r.stdout, "go suite skipped instead of running"
+
+
+@pytest.mark.integration
+def test_node_client_suite(server_port):
+    node = shutil.which("node")
+    if node is None:
+        pytest.skip("node toolchain not installed")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [node, "--test", "test.js"],
+        cwd=os.path.join(REPO, "clients", "nodejs"),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
