@@ -262,9 +262,32 @@ class TcpTransport:
                         self.callback_errors += 1
 
 
-def make_transport(broker: str, port: int) -> Transport:
-    """Config-driven transport selection: "local"/"inproc" -> private
-    InProcessBus; anything else -> TCP broker client."""
+def make_transport(
+    broker: str,
+    port: int,
+    kind: str = "framed",
+    client_id: str = "",
+    username: str = "",
+    password: str = "",
+) -> Transport:
+    """Config-driven transport selection.
+
+    broker "local"/"inproc"/"" -> private InProcessBus; otherwise ``kind``
+    picks the wire: "framed" (default, the self-hosted TcpBroker fabric) or
+    "mqtt" (real MQTT 3.1.1 — join an existing mosquitto-style deployment,
+    the reference's fabric, replication.rs:115-143)."""
     if broker in ("local", "inproc", ""):
         return InProcessBus()
+    if kind == "mqtt":
+        from merklekv_tpu.cluster.transport_mqtt import MqttTransport
+
+        return MqttTransport(
+            broker, port, client_id=client_id,
+            username=username, password=password,
+        )
+    if kind != "framed":
+        # A typo'd kind silently speaking the wrong wire at a real broker
+        # would leave replication dead with no error anywhere (publish is
+        # QoS-0 and swallows transport failures by design).
+        raise ValueError(f"unknown replication transport {kind!r}")
     return TcpTransport(broker, port)

@@ -1,0 +1,366 @@
+"""MQTT 3.1.1 transport: join a real MQTT deployment as the event fabric.
+
+The reference replicates through any MQTT broker (rumqttc -> mosquitto,
+/root/reference/src/replication.rs:115-143). The default fabric here is the
+self-hosted length-framed TcpBroker (transport.py) — but a node configured
+with ``[replication] transport = "mqtt"`` speaks actual MQTT 3.1.1 wire
+frames, so it can join an existing mosquitto/EMQX/HiveMQ deployment (QoS-0;
+the anti-entropy backstop repairs drops, same as the framed fabric).
+
+Implemented subset (all of what replication needs):
+  CONNECT/CONNACK (clean session, optional username/password),
+  PUBLISH QoS-0 in both directions, SUBSCRIBE/SUBACK with a trailing
+  multi-level wildcard, PINGREQ/PINGRESP keepalive, DISCONNECT.
+
+``StubMqttBroker`` is a frame-accurate in-process broker for tests: real
+MQTT framing on real sockets, CONNACK/SUBACK/fan-out semantics — enough to
+prove interop without an external mosquitto (none exists in this image).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["MqttTransport", "StubMqttBroker"]
+
+Callback = Callable[[str, bytes], None]
+
+# Packet types (high nibble of the fixed header).
+_CONNECT = 0x10
+_CONNACK = 0x20
+_PUBLISH = 0x30
+_SUBSCRIBE = 0x82  # QoS-1 control packet per spec (required flags 0b0010)
+_SUBACK = 0x90
+_PINGREQ = 0xC0
+_PINGRESP = 0xD0
+_DISCONNECT = 0xE0
+
+
+def _encode_varlen(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = n % 128
+        n //= 128
+        if n:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def _utf8(s: str) -> bytes:
+    e = s.encode("utf-8")
+    return struct.pack(">H", len(e)) + e
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _read_packet(sock: socket.socket) -> Optional[tuple[int, bytes]]:
+    """One MQTT control packet -> (fixed header byte, payload bytes)."""
+    head = _read_exact(sock, 1)
+    if head is None:
+        return None
+    # Remaining Length: up to 4 varint bytes.
+    mult, length = 1, 0
+    for _ in range(4):
+        b = _read_exact(sock, 1)
+        if b is None:
+            return None
+        length += (b[0] & 0x7F) * mult
+        if not (b[0] & 0x80):
+            break
+        mult *= 128
+    else:
+        return None  # malformed varint
+    body = _read_exact(sock, length) if length else b""
+    if body is None:
+        return None
+    return head[0], body
+
+
+def _topic_matches(filt: str, topic: str) -> bool:
+    """MQTT 3.1.1 filter matching ('#' multi-level, '+' single-level;
+    '#' also matches the parent level, per spec 4.7.1.2)."""
+    if filt == topic:
+        return True
+    fparts = filt.split("/")
+    tparts = topic.split("/")
+    for i, fp in enumerate(fparts):
+        if fp == "#":
+            return True
+        if i >= len(tparts):
+            return False
+        if fp != "+" and fp != tparts[i]:
+            return False
+    return len(fparts) == len(tparts)
+
+
+class MqttTransport:
+    """Transport (transport.py Protocol) over MQTT 3.1.1, QoS-0."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int = 1883,
+        client_id: str = "",
+        username: str = "",
+        password: str = "",
+        keepalive: int = 30,
+        timeout: float = 10.0,
+    ) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._subs: list[tuple[str, Callback]] = []
+        self._mu = threading.Lock()
+        self._send_mu = threading.Lock()
+        self._closed = False
+        self._keepalive = keepalive
+        self.callback_errors = 0
+
+        client_id = client_id or f"mkv-{id(self):x}"
+        flags = 0x02  # clean session
+        payload = _utf8(client_id)
+        if username:
+            flags |= 0x80
+            payload += _utf8(username)
+            if password:
+                flags |= 0x40
+                payload += _utf8(password)
+        var = _utf8("MQTT") + bytes([4, flags]) + struct.pack(">H", keepalive)
+        self._send_packet(_CONNECT, var + payload)
+
+        pkt = _read_packet(self._sock)
+        if pkt is None or (pkt[0] & 0xF0) != _CONNACK:
+            raise ConnectionError("MQTT: no CONNACK")
+        if len(pkt[1]) < 2 or pkt[1][1] != 0:
+            raise ConnectionError(f"MQTT: connection refused rc={pkt[1][1]}")
+        self._sock.settimeout(None)
+
+        self._packet_id = 0
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        self._pinger = threading.Thread(target=self._ping_loop, daemon=True)
+        self._pinger.start()
+
+    # -- Transport interface --------------------------------------------------
+    def publish(self, topic: str, payload: bytes) -> None:
+        body = _utf8(topic) + payload  # QoS-0: no packet id
+        with self._send_mu:
+            try:
+                self._send_packet_locked(_PUBLISH, body)
+            except OSError:
+                pass  # QoS-0: drop on broken broker link
+
+    def subscribe(self, topic_prefix: str, callback: Callback) -> None:
+        with self._mu:
+            self._subs.append((topic_prefix, callback))
+            self._packet_id = self._packet_id % 0xFFFF + 1
+            pid = self._packet_id
+        # '#' matches the prefix level itself and everything below it —
+        # the "{prefix}/events/#" shape the reference subscribes
+        # (replication.rs:142-143).
+        body = struct.pack(">H", pid) + _utf8(topic_prefix + "/#") + b"\x00"
+        with self._send_mu:
+            try:
+                self._send_packet_locked(_SUBSCRIBE, body)
+            except OSError:
+                pass  # reconnect logic is the caller's policy
+
+    def unsubscribe(self, callback: Callback) -> None:
+        with self._mu:
+            self._subs = [(p, c) for p, c in self._subs if c is not callback]
+
+    def close(self) -> None:
+        self._closed = True
+        with self._send_mu:
+            try:
+                self._send_packet_locked(_DISCONNECT, b"")
+            except OSError:
+                pass
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    # -- internals ------------------------------------------------------------
+    def _send_packet(self, header: int, body: bytes) -> None:
+        with self._send_mu:
+            self._send_packet_locked(header, body)
+
+    def _send_packet_locked(self, header: int, body: bytes) -> None:
+        self._sock.sendall(bytes([header]) + _encode_varlen(len(body)) + body)
+
+    def _ping_loop(self) -> None:
+        interval = max(self._keepalive // 2, 1)
+        while not self._closed:
+            time.sleep(interval)
+            if self._closed:
+                return
+            with self._send_mu:
+                try:
+                    self._send_packet_locked(_PINGREQ, b"")
+                except OSError:
+                    return
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            pkt = _read_packet(self._sock)
+            if pkt is None:
+                return
+            header, body = pkt
+            ptype = header & 0xF0
+            if ptype != _PUBLISH:
+                continue  # CONNACK dups / SUBACK / PINGRESP need no action
+            qos = (header >> 1) & 0x03
+            if len(body) < 2:
+                continue
+            (tlen,) = struct.unpack(">H", body[:2])
+            if len(body) < 2 + tlen:
+                continue
+            topic = body[2 : 2 + tlen].decode("utf-8", "surrogateescape")
+            off = 2 + tlen
+            if qos:
+                off += 2  # packet id (broker may deliver QoS>0 publishes)
+            payload = body[off:]
+            with self._mu:
+                subs = list(self._subs)
+            for prefix, cb in subs:
+                if topic.startswith(prefix):
+                    try:
+                        cb(topic, payload)
+                    except Exception:
+                        self.callback_errors += 1
+
+
+class StubMqttBroker:
+    """Frame-accurate MQTT 3.1.1 broker for tests (QoS-0 fan-out).
+
+    Speaks real wire frames on real sockets: CONNECT->CONNACK,
+    SUBSCRIBE->SUBACK, PUBLISH fan-out honoring '#'/'+' filters,
+    PINGREQ->PINGRESP. No retained messages, sessions, or QoS>0 flows."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._mu = threading.Lock()
+        # cid -> (socket, send lock, [topic filters])
+        self._clients: dict[int, tuple[socket.socket, threading.Lock, list]] = {}
+        self._next = 0
+        self._closed = False
+        self.connects = 0
+        self.publishes = 0
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._mu:
+                cid = self._next
+                self._next += 1
+                self._clients[cid] = (sock, threading.Lock(), [])
+            threading.Thread(
+                target=self._serve, args=(cid, sock), daemon=True
+            ).start()
+
+    def _serve(self, cid: int, sock: socket.socket) -> None:
+        while True:
+            pkt = _read_packet(sock)
+            if pkt is None:
+                break
+            header, body = pkt
+            ptype = header & 0xF0
+            if ptype == _CONNECT & 0xF0:
+                self.connects += 1
+                self._send(cid, bytes([_CONNACK, 2, 0, 0]))
+            elif ptype == _SUBSCRIBE & 0xF0:
+                pid = body[:2]
+                filters, rcs = [], b""
+                off = 2
+                while off + 2 <= len(body):
+                    (flen,) = struct.unpack(">H", body[off : off + 2])
+                    f = body[off + 2 : off + 2 + flen].decode("utf-8")
+                    off += 2 + flen + 1  # + requested QoS byte
+                    filters.append(f)
+                    rcs += b"\x00"  # granted QoS 0
+                with self._mu:
+                    if cid in self._clients:
+                        self._clients[cid][2].extend(filters)
+                suback = pid + rcs
+                self._send(
+                    cid,
+                    bytes([_SUBACK]) + _encode_varlen(len(suback)) + suback,
+                )
+            elif ptype == _PUBLISH:
+                self.publishes += 1
+                (tlen,) = struct.unpack(">H", body[:2])
+                topic = body[2 : 2 + tlen].decode("utf-8", "surrogateescape")
+                frame = bytes([_PUBLISH]) + _encode_varlen(len(body)) + body
+                with self._mu:
+                    targets = list(self._clients.items())
+                for tid, (_s, _lk, filters) in targets:
+                    if any(_topic_matches(f, topic) for f in filters):
+                        self._send(tid, frame)
+            elif ptype == _PINGREQ & 0xF0:
+                self._send(cid, bytes([_PINGRESP, 0]))
+            elif ptype == _DISCONNECT & 0xF0:
+                break
+        self._drop(cid)
+
+    def _send(self, cid: int, frame: bytes) -> None:
+        with self._mu:
+            entry = self._clients.get(cid)
+        if entry is None:
+            return
+        sock, lock, _ = entry
+        try:
+            with lock:
+                sock.sendall(frame)
+        except OSError:
+            self._drop(cid)
+
+    def _drop(self, cid: int) -> None:
+        with self._mu:
+            entry = self._clients.pop(cid, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mu:
+            entries = list(self._clients.values())
+            self._clients.clear()
+        for s, _lk, _f in entries:
+            try:
+                s.close()
+            except OSError:
+                pass

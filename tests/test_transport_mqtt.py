@@ -1,0 +1,191 @@
+"""MQTT 3.1.1 transport (VERDICT r4 item 6).
+
+Frame-level tests against StubMqttBroker (real MQTT wire frames on real
+sockets — CONNACK/SUBACK/PUBLISH fan-out/PINGRESP), plus end-to-end
+replication between two ClusterNodes whose fabric is `transport = "mqtt"`.
+"""
+
+import socket
+import struct
+import time
+import uuid
+
+import pytest
+
+from merklekv_tpu.cluster.transport_mqtt import (
+    MqttTransport,
+    StubMqttBroker,
+    _topic_matches,
+)
+
+
+@pytest.fixture
+def broker():
+    b = StubMqttBroker()
+    yield b
+    b.close()
+
+
+def wait_for(fn, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_topic_filter_matching():
+    assert _topic_matches("a/events/#", "a/events")  # parent level
+    assert _topic_matches("a/events/#", "a/events/x/y")
+    assert not _topic_matches("a/events/#", "a/other")
+    assert _topic_matches("a/+/c", "a/b/c")
+    assert not _topic_matches("a/+/c", "a/b/d")
+    assert not _topic_matches("a/+", "a/b/c")
+    assert _topic_matches("#", "anything/at/all")
+
+
+def test_connect_publish_subscribe_round_trip(broker):
+    got = []
+    t1 = MqttTransport(broker.host, broker.port, client_id="c1")
+    t2 = MqttTransport(broker.host, broker.port, client_id="c2")
+    try:
+        t2.subscribe("t/events", lambda topic, p: got.append((topic, p)))
+        time.sleep(0.05)  # let SUBACK land before publishing
+        t1.publish("t/events", b"payload-1")
+        assert wait_for(lambda: got == [("t/events", b"payload-1")])
+        assert broker.connects == 2
+        assert broker.publishes >= 1
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_publisher_receives_own_messages_like_mqtt(broker):
+    """MQTT fan-out includes the publisher when it subscribes — the
+    replicator's src-based loop prevention depends on seeing (and
+    skipping) its own events, same as with a real broker."""
+    got = []
+    t = MqttTransport(broker.host, broker.port, client_id="self")
+    try:
+        t.subscribe("s/events", lambda topic, p: got.append(p))
+        time.sleep(0.05)
+        t.publish("s/events", b"echo")
+        assert wait_for(lambda: got == [b"echo"])
+    finally:
+        t.close()
+
+
+def test_frames_are_real_mqtt(broker):
+    """Hand-rolled socket speaking raw MQTT 3.1.1 frames interoperates
+    with the broker — proving the wire format, not just the Python API."""
+    sock = socket.create_connection((broker.host, broker.port), timeout=5)
+    try:
+        # CONNECT: protocol name "MQTT", level 4, clean session.
+        cid = b"rawcli"
+        var = struct.pack(">H", 4) + b"MQTT" + bytes([4, 0x02]) + struct.pack(">H", 30)
+        payload = struct.pack(">H", len(cid)) + cid
+        body = var + payload
+        sock.sendall(bytes([0x10, len(body)]) + body)
+        connack = sock.recv(4)
+        assert connack == bytes([0x20, 2, 0, 0])
+
+        # SUBSCRIBE to raw/#
+        filt = b"raw/#"
+        body = struct.pack(">H", 7) + struct.pack(">H", len(filt)) + filt + b"\x00"
+        sock.sendall(bytes([0x82, len(body)]) + body)
+        suback = sock.recv(5)
+        assert suback == bytes([0x90, 3, 0, 7, 0])
+
+        # PUBLISH from a transport client; the raw socket must receive a
+        # spec-shaped PUBLISH frame.
+        t = MqttTransport(broker.host, broker.port, client_id="pub")
+        try:
+            t.publish("raw/events", b"xyz")
+            sock.settimeout(5)
+            frame = sock.recv(256)
+            assert frame[0] == 0x30  # PUBLISH, QoS-0
+            rem = frame[1]
+            (tlen,) = struct.unpack(">H", frame[2:4])
+            assert frame[4 : 4 + tlen] == b"raw/events"
+            assert frame[4 + tlen :] == b"xyz"
+            assert rem == 2 + tlen + 3
+        finally:
+            t.close()
+    finally:
+        sock.close()
+
+
+def test_ping_keepalive(broker):
+    t = MqttTransport(broker.host, broker.port, client_id="ping", keepalive=2)
+    try:
+        # The ping loop fires at keepalive/2 = 1s; surviving 2.5s proves
+        # PINGREQ/PINGRESP round-trips don't wedge the read loop.
+        got = []
+        t.subscribe("ka/events", lambda topic, p: got.append(p))
+        time.sleep(2.5)
+        t.publish("ka/events", b"alive")
+        assert wait_for(lambda: got == [b"alive"])
+    finally:
+        t.close()
+
+
+def test_auth_fields_accepted(broker):
+    t = MqttTransport(
+        broker.host, broker.port, client_id="auth",
+        username="u", password="p",
+    )
+    t.close()
+    assert broker.connects >= 1
+
+
+@pytest.mark.integration
+def test_replication_over_mqtt_fabric(broker):
+    """Two ClusterNodes whose [replication] transport = "mqtt" converge
+    through the (stub, frame-accurate) MQTT broker."""
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.config import Config
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    topic = f"mq-{uuid.uuid4().hex[:8]}"
+
+    def make_node(node_id):
+        engine = NativeEngine("mem")
+        server = NativeServer(engine, "127.0.0.1", 0)
+        server.start()
+        cfg = Config()
+        cfg.replication.enabled = True
+        cfg.replication.transport = "mqtt"
+        cfg.replication.mqtt_broker = broker.host
+        cfg.replication.mqtt_port = broker.port
+        cfg.replication.topic_prefix = topic
+        cfg.replication.client_id = node_id
+        node = ClusterNode(cfg, engine, server)
+        node.start()
+        client = MerkleKVClient("127.0.0.1", server.port, timeout=15).connect()
+        return engine, server, node, client
+
+    e1, s1, n1, c1 = make_node("mq-1")
+    e2, s2, n2, c2 = make_node("mq-2")
+    try:
+        c1.set("mqtt-key", "mqtt-value")
+        assert wait_for(lambda: c2.get("mqtt-key") == "mqtt-value")
+        c2.set("reverse", "path")
+        assert wait_for(lambda: c1.get("reverse") == "path")
+        c1.delete("mqtt-key")
+        assert wait_for(lambda: c2.get("mqtt-key") is None)
+        assert wait_for(lambda: c1.hash() == c2.hash())
+    finally:
+        for cl, nd, sv, en in ((c1, n1, s1, e1), (c2, n2, s2, e2)):
+            cl.close()
+            nd.stop()
+            sv.close()
+            en.close()
+
+
+def test_unknown_transport_kind_rejected():
+    from merklekv_tpu.cluster.transport import make_transport
+
+    with pytest.raises(ValueError, match="unknown replication transport"):
+        make_transport("somehost", 1883, kind="MQTT")  # typo'd case
