@@ -323,3 +323,100 @@ def test_equal_ts_cross_writer_converges_without_sync():
     finally:
         e1.close()
         e2.close()
+
+
+class LossyTransport:
+    """Transport wrapper dropping a deterministic fraction of publishes —
+    frame loss on the QoS-0 fabric (VERDICT r4 item 10)."""
+
+    def __init__(self, inner, drop_rate: float, seed: int = 7):
+        import random
+
+        self._inner = inner
+        self._rng = random.Random(seed)
+        self._rate = drop_rate
+        self.dropped = 0
+        self.passed = 0
+
+    def publish(self, topic, payload):
+        if self._rng.random() < self._rate:
+            self.dropped += 1
+            return  # frame lost in transit
+        self.passed += 1
+        self._inner.publish(topic, payload)
+
+    def subscribe(self, prefix, cb):
+        self._inner.subscribe(prefix, cb)
+
+    def unsubscribe(self, cb):
+        self._inner.unsubscribe(cb)
+
+    def close(self):
+        self._inner.close()
+
+
+@pytest.mark.integration
+def test_convergence_under_frame_loss(broker):
+    """QoS-0 replication + periodic anti-entropy converge under heavy frame
+    loss — the design argument behind dropping the reference's QoS-1
+    (replication.rs:257-264) becomes a measured number. 40% of publishes
+    are dropped; the anti-entropy loop (200 ms interval) must repair every
+    hole. The reference's own budget for LOSSLESS propagation through a
+    public broker is 3-5 s (README.md:56)."""
+    from merklekv_tpu.cluster.transport import TcpTransport
+
+    topic = f"loss-{uuid.uuid4().hex[:8]}"
+
+    def make_node(node_id, peers):
+        engine = NativeEngine("mem")
+        server = NativeServer(engine, "127.0.0.1", 0)
+        server.start()
+        cfg = Config()
+        cfg.replication.enabled = True
+        cfg.replication.mqtt_broker = broker.host
+        cfg.replication.mqtt_port = broker.port
+        cfg.replication.topic_prefix = topic
+        cfg.replication.client_id = node_id
+        cfg.anti_entropy.enabled = True
+        cfg.anti_entropy.interval_seconds = 0.2
+        cfg.anti_entropy.peers = peers
+        lossy = LossyTransport(
+            TcpTransport(broker.host, broker.port), drop_rate=0.4
+        )
+        node = ClusterNode(cfg, engine, server, transport=lossy)
+        node.start()
+        client = MerkleKVClient("127.0.0.1", server.port, timeout=15).connect()
+        return engine, server, node, client, lossy
+
+    e1, s1, n1, c1, t1 = make_node("loss-1", [])
+    # Node 2 periodically syncs FROM node 1 (the anti-entropy backstop).
+    e2, s2, n2, c2, t2 = make_node("loss-2", [f"127.0.0.1:{s1.port}"])
+    try:
+        n_keys = 60
+        t0 = time.time()
+        for i in range(n_keys):
+            c1.set(f"loss{i:03d}", f"v{i}")
+        c1.delete("loss000")  # a deletion must survive loss too
+
+        def converged():
+            return c1.hash() == c2.hash()
+
+        assert wait_for(converged, timeout=30), (
+            f"no convergence: dropped={t1.dropped} passed={t1.passed}"
+        )
+        seconds = time.time() - t0
+        # The point of the test: real loss happened AND we converged.
+        assert t1.dropped > 0, "drop injector never fired"
+        assert c2.get("loss001") == "v1"
+        assert c2.get("loss000") is None
+        # Report the number (visible with -s / in CI logs).
+        print(
+            f"\nconverged in {seconds:.2f}s with "
+            f"{t1.dropped}/{t1.dropped + t1.passed} frames dropped"
+        )
+    finally:
+        for cl, nd, sv, en in ((c1, n1, s1, e1), (c2, n2, s2, e2)):
+            cl.close()
+            nd.stop()
+            sv.close()
+            en.close()
