@@ -171,6 +171,23 @@ int mkv_engine_tombstones(void* h, const char* prefix, int plen, char** out,
   return 1;
 }
 
+// key_timestamps: same wire shape as tombstones (u32 count, then u32 klen +
+// key + u64 last-write-ts) over every LIVE key, shard order (unsorted).
+// Free with mkv_free.
+int mkv_engine_key_timestamps(void* h, char** out, int* out_len) {
+  auto items = static_cast<Engine*>(h)->key_timestamps();
+  std::string buf;
+  put_u32(buf, uint32_t(items.size()));
+  for (const auto& [k, ts] : items) {
+    put_u32(buf, uint32_t(k.size()));
+    buf += k;
+    put_u64(buf, ts);
+  }
+  *out = dup_buffer(buf);
+  *out_len = int(buf.size());
+  return 1;
+}
+
 int mkv_engine_exists(void* h, const char* key, int klen) {
   return static_cast<Engine*>(h)->exists(std::string(key, size_t(klen))) ? 1
                                                                           : 0;

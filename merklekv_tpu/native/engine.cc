@@ -229,6 +229,18 @@ std::vector<std::pair<std::string, uint64_t>> MemEngine::tombstones(
   return out;
 }
 
+std::vector<std::pair<std::string, uint64_t>> MemEngine::key_timestamps() {
+  std::vector<std::pair<std::string, uint64_t>> out;
+  for (Shard& s : shards_) {
+    std::shared_lock lk(s.mu);
+    for (const auto& [k, e] : s.map) out.emplace_back(k, e.ts);
+  }
+  // Deliberately unsorted: the consumer builds a hash map, and an
+  // O(N log N) string sort at 10M keys would cost more than the FFI
+  // batching this export exists to save.
+  return out;
+}
+
 bool MemEngine::exists(const std::string& key) {
   Shard& s = shard_for(key);
   std::shared_lock lk(s.mu);

@@ -81,6 +81,12 @@ class Engine {
   // Sorted (key, delete-ts) tombstones with the given prefix ("" = all).
   virtual std::vector<std::pair<std::string, uint64_t>> tombstones(
       const std::string& prefix) = 0;
+  // (key, last-write-ts) for every LIVE key, in shard order (unsorted) —
+  // the bulk export the multi-peer LWW arbitration consumes (a per-key
+  // get_ts would pay one FFI call + shard lock per key across the whole
+  // divergent set; the consumer builds a hash map, so sorting would be
+  // wasted work).
+  virtual std::vector<std::pair<std::string, uint64_t>> key_timestamps() = 0;
   virtual bool exists(const std::string& key) = 0;
   // Sorted keys with the given prefix ("" = all).
   virtual std::vector<std::string> scan(const std::string& prefix) = 0;
@@ -133,6 +139,7 @@ class MemEngine : public Engine {
   std::optional<uint64_t> tombstone_ts(const std::string& key) override;
   std::vector<std::pair<std::string, uint64_t>> tombstones(
       const std::string& prefix) override;
+  std::vector<std::pair<std::string, uint64_t>> key_timestamps() override;
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
   size_t dbsize() override;
@@ -203,6 +210,9 @@ class LogEngine : public Engine {
   std::optional<uint64_t> tombstone_ts(const std::string& key) override;
   std::vector<std::pair<std::string, uint64_t>> tombstones(
       const std::string& prefix) override;
+  std::vector<std::pair<std::string, uint64_t>> key_timestamps() override {
+    return mem_.key_timestamps();
+  }
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
   size_t dbsize() override;

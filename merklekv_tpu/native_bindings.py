@@ -110,6 +110,9 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
         P(ctypes.c_void_p), P(ctypes.c_int),
     ]
+    lib.mkv_engine_key_timestamps.argtypes = [
+        ctypes.c_void_p, P(ctypes.c_void_p), P(ctypes.c_int),
+    ]
     lib.mkv_engine_exists.argtypes = lib.mkv_engine_del.argtypes
     lib.mkv_engine_dbsize.restype = ctypes.c_longlong
     lib.mkv_engine_dbsize.argtypes = [ctypes.c_void_p]
@@ -282,14 +285,12 @@ class NativeEngine:
             return None
         return int(ts.value)
 
-    def tombstones(self, prefix: bytes = b"") -> list[tuple[bytes, int]]:
-        """Sorted (key, delete-ts) tombstones — the deletion half of the
-        anti-entropy exchange."""
+    def _read_kv_ts(self, fn, *args) -> list[tuple[bytes, int]]:
+        """Call a C export returning the shared (u32 count, then u32 klen +
+        key + u64 ts per item) wire shape and decode it."""
         out = ctypes.c_void_p()
         out_len = ctypes.c_int()
-        self._lib.mkv_engine_tombstones(
-            self._h, prefix, len(prefix), ctypes.byref(out), ctypes.byref(out_len)
-        )
+        fn(*args, ctypes.byref(out), ctypes.byref(out_len))
         buf = _take_buffer(self._lib, out, out_len.value)
         (n,) = struct.unpack_from("<I", buf, 0)
         items, off = [], 4
@@ -302,6 +303,19 @@ class NativeEngine:
             off += 8
             items.append((k, ts))
         return items
+
+    def tombstones(self, prefix: bytes = b"") -> list[tuple[bytes, int]]:
+        """Sorted (key, delete-ts) tombstones — the deletion half of the
+        anti-entropy exchange."""
+        return self._read_kv_ts(
+            self._lib.mkv_engine_tombstones, self._h, prefix, len(prefix)
+        )
+
+    def key_timestamps(self) -> list[tuple[bytes, int]]:
+        """(key, last-write-ts) for every live key in one native call,
+        shard order — the bulk export multi-peer LWW arbitration consumes
+        (it builds a map; sorting would be wasted work)."""
+        return self._read_kv_ts(self._lib.mkv_engine_key_timestamps, self._h)
 
     def exists(self, key: bytes) -> bool:
         return bool(self._lib.mkv_engine_exists(self._h, key, len(key)))

@@ -449,3 +449,11 @@ def test_tomb_evictions_counter(eng):
     assert eng.tomb_evictions() == 0
     eng.delete_with_ts(b"t1", 10)
     assert eng.tomb_evictions() == 0  # far below the per-shard cap
+
+
+def test_key_timestamps_bulk_export(eng):
+    eng.set_with_ts(b"ka", b"1", 100)
+    eng.set_with_ts(b"kb", b"2", 200)
+    eng.set_with_ts(b"kc", b"3", 300)
+    eng.delete_with_ts(b"kb", 400)
+    assert sorted(eng.key_timestamps()) == [(b"ka", 100), (b"kc", 300)]

@@ -455,3 +455,52 @@ def test_sync_multi_full_snapshot_fallback_peer(three_nodes):
     assert local_eng.get(b"fb") == b"from-fallback"  # union still grows
     assert local_eng.get(b"fresh") == b"mine"  # fallback never overwrites
     assert any("full snapshot" in d for d in report.details)
+
+
+def test_sync_multi_randomized_converges_to_lww_merge(three_nodes):
+    """Randomized stress of the vectorized arbitration: three engines with
+    interleaved writes, deletions, and tombstones at explicit timestamps.
+    After every node runs sync_multi against the others, all three must
+    hold the same keyspace, and it must equal the brute-force
+    (ts, liveness, digest) merge computed independently in Python."""
+    import random
+
+    from merklekv_tpu.merkle.encoding import leaf_hash
+
+    engines = [e for e, _ in three_nodes]
+    servers = [s for _, s in three_nodes]
+    rng = random.Random(42)
+    n_keys = 200
+    # expected[key] = best (ts, live, digest, value) candidate
+    expected: dict[bytes, tuple] = {}
+    for i in range(n_keys):
+        key = b"rz%04d" % i
+        for slot, eng in enumerate(engines):
+            roll = rng.random()
+            ts = rng.randrange(1, 10**6)
+            if roll < 0.55:
+                val = b"v%d-%d" % (slot, rng.randrange(1000))
+                eng.set_with_ts(key, val, ts)
+                cand = (ts, 1, leaf_hash(key, val), val)
+            elif roll < 0.75:
+                eng.delete_with_ts(key, ts)
+                cand = (ts, 0, b"", None)
+            else:
+                continue  # this replica never saw the key
+            best = expected.get(key)
+            if best is None or cand[:3] > best[:3]:
+                expected[key] = cand
+
+    addrs = [f"127.0.0.1:{srv.port}" for srv in servers]
+    # Two rounds so second-hand state propagates everywhere.
+    for _round in range(2):
+        for me in range(3):
+            peers = [addrs[p] for p in range(3) if p != me]
+            SyncManager(engines[me], device="cpu").sync_multi(peers)
+
+    want_live = {
+        k: c[3] for k, c in expected.items() if c[1] == 1
+    }
+    for slot, eng in enumerate(engines):
+        got = {k: v for k, v in eng.snapshot()}
+        assert got == want_live, f"node {slot} diverged from LWW merge"
