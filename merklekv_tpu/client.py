@@ -325,16 +325,29 @@ class MerkleKVClient:
     def memory(self) -> int:
         return _count_after(self._request("MEMORY"), "MEMORY ")
 
-    def client_list(self) -> list[dict[str, str]]:
-        resp = _parse_simple(self._request("CLIENT LIST"))
-        if resp != "CLIENT LIST":
-            raise ProtocolError(f"unexpected response: {resp}")
+    def _read_field_table(self) -> list[dict[str, str]]:
+        """Lines of space-separated ``k=v`` fields closed by ``END``
+        (CLIENT LIST, PEERS)."""
         rows = []
         while True:
             line = self._read_line()
             if line == "END":
                 return rows
             rows.append(dict(f.split("=", 1) for f in line.split(" ") if "=" in f))
+
+    def client_list(self) -> list[dict[str, str]]:
+        resp = _parse_simple(self._request("CLIENT LIST"))
+        if resp != "CLIENT LIST":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_field_table()
+
+    def peers(self) -> list[dict[str, str]]:
+        """Per-peer health table (PEERS extension verb): one dict per
+        configured peer with addr/status/failures/rtt_ms/last_ok."""
+        resp = _parse_simple(self._request("PEERS"))
+        if not resp.startswith("PEERS "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_field_table()
 
     def flushdb(self) -> bool:
         return _parse_simple(self._request("FLUSHDB")) == "OK"

@@ -1,0 +1,161 @@
+"""Peer failure detection: periodic PING probes + per-peer health table.
+
+The reference has no peer health at all — a down peer is discovered only
+when a sync attempt times out (SURVEY §5.3: "no peer health checks, no
+membership"). Here a background monitor probes every configured peer with a
+short-timeout PING, tracks (status, consecutive failures, last-ok time,
+round-trip), feeds the metrics registry, and lets the anti-entropy loop
+skip known-down peers instead of burning a full connect timeout per cycle.
+Surfaced over the wire as the extension verb ``PEERS`` (docs/PROTOCOL.md).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from merklekv_tpu.utils.tracing import get_metrics
+
+__all__ = ["PeerHealth", "PeerHealthMonitor"]
+
+
+@dataclass
+class PeerHealth:
+    peer: str  # "host:port"
+    # "unknown" until the first probe lands; "down" only after down_after
+    # consecutive failures; one success flips back to "up".
+    status: str = "unknown"
+    consecutive_failures: int = 0
+    last_ok_unix: float = 0.0
+    last_probe_unix: float = 0.0
+    rtt_ms: float = -1.0
+    probes: int = 0
+
+
+class PeerHealthMonitor:
+    """Background PING prober over the cluster's peer list."""
+
+    def __init__(
+        self,
+        peers: list[str],
+        interval_seconds: float = 2.0,
+        timeout: float = 1.0,
+        down_after: int = 2,
+    ) -> None:
+        self._interval = interval_seconds
+        self._timeout = timeout
+        self._down_after = down_after
+        self._mu = threading.Lock()
+        self._health: dict[str, PeerHealth] = {
+            p: PeerHealth(peer=p) for p in peers
+        }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="mkv-peer-health"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- probing -------------------------------------------------------------
+    def probe_all(self) -> None:
+        """One synchronous probe round (the loop body; tests call directly)."""
+        with self._mu:
+            peers = list(self._health)
+        for peer in peers:
+            ok, rtt = self._probe(peer)
+            self._record(peer, ok, rtt)
+
+    def _probe(self, peer: str) -> tuple[bool, float]:
+        host, _, port = peer.rpartition(":")
+        t0 = time.perf_counter()
+        try:
+            with socket.create_connection(
+                (host, int(port)), timeout=self._timeout
+            ) as sock:
+                sock.settimeout(self._timeout)
+                sock.sendall(b"PING health\r\n")
+                buf = b""
+                while not buf.endswith(b"\n"):
+                    chunk = sock.recv(256)
+                    if not chunk:
+                        return False, -1.0
+                    buf += chunk
+                if not buf.startswith(b"PONG"):
+                    return False, -1.0
+        except (OSError, ValueError):
+            return False, -1.0
+        return True, (time.perf_counter() - t0) * 1e3
+
+    def _record(self, peer: str, ok: bool, rtt_ms: float) -> None:
+        now = time.time()
+        with self._mu:
+            h = self._health.get(peer)
+            if h is None:
+                return
+            h.probes += 1
+            h.last_probe_unix = now
+            if ok:
+                if h.status == "down":
+                    get_metrics().inc("health.peer_recoveries")
+                h.status = "up"
+                h.consecutive_failures = 0
+                h.last_ok_unix = now
+                h.rtt_ms = rtt_ms
+            else:
+                h.consecutive_failures += 1
+                if (
+                    h.consecutive_failures >= self._down_after
+                    and h.status != "down"
+                ):
+                    h.status = "down"
+                    get_metrics().inc("health.peer_failures")
+
+    def _run(self) -> None:
+        # First round immediately so the table is useful right away.
+        while True:
+            try:
+                self.probe_all()
+            except Exception:
+                get_metrics().inc("health.probe_errors")
+            if self._stop.wait(self._interval):
+                return
+
+    # -- queries -------------------------------------------------------------
+    def is_up(self, peer: str) -> bool:
+        """False only for peers confirmed down; unknown/unconfigured peers
+        answer True so nothing is skipped on startup."""
+        with self._mu:
+            h = self._health.get(peer)
+            return h is None or h.status != "down"
+
+    def snapshot(self) -> list[PeerHealth]:
+        with self._mu:
+            return [PeerHealth(**vars(h)) for h in self._health.values()]
+
+    def wire_table(self) -> str:
+        """The PEERS response body (extension verb)."""
+        rows = self.snapshot()
+        out = f"PEERS {len(rows)}\r\n"
+        for h in rows:
+            out += (
+                f"addr={h.peer} status={h.status} "
+                f"failures={h.consecutive_failures} "
+                f"rtt_ms={h.rtt_ms:.2f} last_ok={int(h.last_ok_unix)}\r\n"
+            )
+        out += "END\r\n"
+        return out

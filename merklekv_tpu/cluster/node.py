@@ -36,6 +36,7 @@ class ClusterNode:
         self._owns_transport = transport is None
         self._replicator: Optional[Replicator] = None
         self._mirror = None  # DeviceTreeMirror, alive while replication is on
+        self._health = None  # PeerHealthMonitor, alive with the sync loop
         self._rep_mu = threading.Lock()
         self.sync_manager = SyncManager(
             engine,
@@ -52,14 +53,30 @@ class ClusterNode:
                 print(f"replication not started: {err}", file=sys.stderr,
                       flush=True)
         if self._cfg.anti_entropy.enabled and self._cfg.anti_entropy.peers:
+            # Failure detection: probe peers off the sync path so the loop
+            # can skip confirmed-down peers instead of burning a connect
+            # timeout per cycle (reference has no peer health, SURVEY §5.3).
+            from merklekv_tpu.cluster.health import PeerHealthMonitor
+
+            self._health = PeerHealthMonitor(
+                self._cfg.anti_entropy.peers,
+                interval_seconds=min(
+                    self._cfg.anti_entropy.interval_seconds, 2.0
+                ),
+            )
+            self._health.start()
             self.sync_manager.start_loop(
                 self._cfg.anti_entropy.peers,
                 self._cfg.anti_entropy.interval_seconds,
                 multi_peer=self._cfg.anti_entropy.multi_peer,
+                peer_up=self._health.is_up,
             )
 
     def stop(self) -> None:
         self.sync_manager.stop()
+        if self._health is not None:
+            self._health.stop()
+            self._health = None
         self._disable_replication()
         if self._owns_transport and self._transport is not None:
             self._transport.close()
@@ -150,9 +167,17 @@ class ClusterNode:
         except Exception:
             return None  # native fallback answers instead
 
+    @property
+    def health(self):
+        return self._health
+
     # -- cluster command callback ---------------------------------------------
     def _on_cluster_command(self, line: str) -> Optional[str]:
         parts = line.split()
+        if parts[0] == "PEERS":
+            if self._health is None:
+                return None  # native default: empty table
+            return self._health.wire_table()
         if parts[0] == "HASH":
             # Whole-keyspace root served from the device-resident
             # incremental tree; empty answer falls back to the native path.

@@ -527,7 +527,9 @@ class SyncManager:
             _I64MAX = (1 << 63) - 1
             ts_m = np.zeros((n_rep, n_div), np.int64)
             ts_m[0] = [
-                min(local_ts(k), _I64MAX) if p else local_tombs.get(k, -1)
+                min(local_ts(k), _I64MAX)
+                if p
+                else min(local_tombs.get(k, -1), _I64MAX)
                 for k, p in zip(keys_div, pres[0])
             ]
             for slot in range(1, n_rep):
@@ -649,22 +651,42 @@ class SyncManager:
         peers: list[str],
         interval_seconds: float,
         multi_peer: bool = False,
+        peer_up=None,  # Callable[[str], bool] from the health monitor
     ) -> None:
         """Periodic anti-entropy: pairwise per peer, or one fused
-        multi-peer arbitration cycle when ``multi_peer`` is set."""
+        multi-peer arbitration cycle when ``multi_peer`` is set.
+
+        ``peer_up`` (the failure detector's verdict) lets a cycle skip
+        confirmed-down peers instead of paying a connect timeout each; the
+        monitor keeps probing, so a recovered peer rejoins the next cycle.
+        """
+
+        def up(peer: str) -> bool:
+            if peer_up is None:
+                return True
+            try:
+                return bool(peer_up(peer))
+            except Exception:
+                return True  # a broken detector must not stall repairs
 
         def run() -> None:
             while not self._stop.wait(interval_seconds):
+                live_peers = [p for p in peers if up(p)]
+                skipped = len(peers) - len(live_peers)
+                if skipped:
+                    get_metrics().inc("anti_entropy.down_peer_skips", skipped)
                 if multi_peer:
+                    if not live_peers:
+                        continue
                     try:
-                        self.sync_multi(peers)
+                        self.sync_multi(live_peers)
                     except Exception:
                         # Retried next round — but never silently: a loop
                         # that throws every cycle looks like a healthy
                         # no-op without this counter.
                         get_metrics().inc("anti_entropy.loop_errors")
                     continue
-                for peer in peers:
+                for peer in live_peers:
                     if self._stop.is_set():
                         return
                     host, _, port = peer.rpartition(":")

@@ -21,6 +21,9 @@ enum class Verb {
   MultiGet, MultiSet, Truncate, Exists, Scan, Dbsize, Hash,
   LeafHashes, Stats, Info, Version, Memory, ClientList, Flushdb, Shutdown,
   Ping, Echo, Sync, Replicate,
+  // Extension (like LEAFHASHES): per-peer health table from the cluster
+  // control plane's failure detector.
+  Peers,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };

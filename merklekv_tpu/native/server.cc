@@ -268,6 +268,20 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       out += "END\r\n";
       return out;
     }
+    case Verb::Peers: {
+      // Per-peer health from the control plane's failure detector
+      // (extension verb — the reference has no peer health at all).
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp = cb("PEERS");
+        if (!resp.empty()) return resp;
+      }
+      return "PEERS 0\r\nEND\r\n";
+    }
     case Verb::Sync:
     case Verb::Replicate: {
       ClusterCallback cb;

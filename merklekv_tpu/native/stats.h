@@ -87,6 +87,7 @@ struct ServerStats {
       case Verb::Shutdown:
       case Verb::ClientList: management_commands++; break;
       case Verb::Memory: memory_commands++; break;
+      case Verb::Peers: management_commands++; break;
       case Verb::Sync: sync_commands++; break;
       case Verb::Hash:
       case Verb::LeafHashes: hash_commands++; break;

@@ -1,0 +1,145 @@
+"""Peer failure detection (SURVEY §5.3 — the reference has none).
+
+PING-probe monitor state machine, the PEERS wire verb, anti-entropy
+down-peer skipping, and recovery.
+"""
+
+import time
+import uuid
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.cluster.health import PeerHealthMonitor
+from merklekv_tpu.cluster.node import ClusterNode
+from merklekv_tpu.cluster.sync import SyncManager
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+
+@pytest.fixture
+def server():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    yield eng, srv
+    srv.close()
+    eng.close()
+
+
+def test_monitor_marks_up_and_down(server):
+    eng, srv = server
+    peer = f"127.0.0.1:{srv.port}"
+    mon = PeerHealthMonitor([peer], timeout=0.5, down_after=2)
+    assert mon.is_up(peer)  # unknown = optimistic
+    mon.probe_all()
+    snap = {h.peer: h for h in mon.snapshot()}
+    assert snap[peer].status == "up"
+    assert snap[peer].rtt_ms >= 0
+
+    srv.close()
+    mon.probe_all()
+    assert mon.is_up(peer)  # one failure: not confirmed down yet
+    mon.probe_all()
+    assert not mon.is_up(peer)  # down_after=2 reached
+    snap = {h.peer: h for h in mon.snapshot()}
+    assert snap[peer].status == "down"
+    assert snap[peer].consecutive_failures >= 2
+
+
+def test_monitor_recovery():
+    # A peer that starts dead and later comes up flips to "up".
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    port = srv.port
+    srv.close()
+    try:
+        mon = PeerHealthMonitor([f"127.0.0.1:{port}"], timeout=0.3,
+                                down_after=1)
+        mon.probe_all()
+        assert not mon.is_up(f"127.0.0.1:{port}")
+        srv2 = NativeServer(eng, "127.0.0.1", port)
+        srv2.start()  # raises on bind failure
+        try:
+            mon.probe_all()
+            assert mon.is_up(f"127.0.0.1:{port}")
+        finally:
+            srv2.close()
+    finally:
+        eng.close()
+
+
+def test_peers_verb_without_cluster_plane(server):
+    _, srv = server
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        assert c.peers() == []  # native default: empty table
+
+
+def test_peers_verb_serves_health_table(server):
+    eng, srv = server
+    # A second live node as the peer.
+    peng = NativeEngine("mem")
+    psrv = NativeServer(peng, "127.0.0.1", 0)
+    psrv.start()
+    cfg = Config()
+    cfg.anti_entropy.enabled = True
+    cfg.anti_entropy.peers = [f"127.0.0.1:{psrv.port}", "127.0.0.1:1"]
+    cfg.anti_entropy.interval_seconds = 30  # loop mostly idle in this test
+    node = ClusterNode(cfg, eng, srv)
+    node.start()
+    try:
+        deadline = time.time() + 10
+        rows = []
+        while time.time() < deadline:
+            with MerkleKVClient("127.0.0.1", srv.port) as c:
+                rows = c.peers()
+            if len(rows) == 2 and all(r["status"] != "unknown" for r in rows):
+                break
+            time.sleep(0.1)
+        by_addr = {r["addr"]: r for r in rows}
+        assert by_addr[f"127.0.0.1:{psrv.port}"]["status"] == "up"
+        # port 1: nothing listens there; confirmed down after 2 probes.
+        assert by_addr["127.0.0.1:1"]["status"] in ("down", "unknown")
+    finally:
+        node.stop()
+        psrv.close()
+        peng.close()
+
+
+def test_sync_loop_skips_confirmed_down_peers(server):
+    """The loop consults the failure detector and skips down peers (no
+    connect timeout burned), while live peers still repair."""
+    eng, srv = server
+    peng = NativeEngine("mem")
+    psrv = NativeServer(peng, "127.0.0.1", 0)
+    psrv.start()
+    peng.set(b"from-peer", b"repaired")
+
+    down = {"127.0.0.1:1": False}  # detector verdict per peer
+
+    def peer_up(p):
+        return down.get(p, True)
+
+    mgr = SyncManager(eng, device="cpu")
+    mgr.start_loop(
+        ["127.0.0.1:1", f"127.0.0.1:{psrv.port}"],
+        interval_seconds=0.1,
+        peer_up=peer_up,
+    )
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if eng.get(b"from-peer") == b"repaired":
+                break
+            time.sleep(0.05)
+        assert eng.get(b"from-peer") == b"repaired"
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        assert get_metrics().snapshot()["counters"].get(
+            "anti_entropy.down_peer_skips", 0
+        ) >= 1
+    finally:
+        mgr.stop()
+        psrv.close()
+        peng.close()

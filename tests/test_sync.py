@@ -504,3 +504,18 @@ def test_sync_multi_randomized_converges_to_lww_merge(three_nodes):
     for slot, eng in enumerate(engines):
         got = {k: v for k, v in eng.snapshot()}
         assert got == want_live, f"node {slot} diverged from LWW merge"
+
+
+def test_sync_multi_corrupt_clock_tombstone_does_not_wedge(three_nodes):
+    """A tombstone with ts >= 2^63 (corrupt clock) must lose gracefully in
+    arbitration, not abort every cycle with OverflowError."""
+    engines = [e for e, _ in three_nodes]
+    servers = [s for _, s in three_nodes]
+    huge = (1 << 63) + 5
+    engines[0].delete_with_ts(b"wedge", huge)  # corrupt local tombstone
+    engines[1].set_with_ts(b"wedge", b"sane", 1000)
+    peers = [f"127.0.0.1:{servers[1].port}"]
+    report = SyncManager(engines[0], device="cpu").sync_multi(peers)
+    assert report.union_keys >= 1  # the cycle completed
+    # The clamped tombstone (int64 max) still out-ranks the sane write.
+    assert engines[0].get(b"wedge") is None
