@@ -1,0 +1,316 @@
+package io.merklekv.client;
+
+import java.io.BufferedReader;
+import java.io.IOException;
+import java.io.InputStreamReader;
+import java.io.OutputStream;
+import java.net.InetSocketAddress;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.HashMap;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.Optional;
+
+/**
+ * Java client for the merklekv_tpu text protocol (docs/PROTOCOL.md; same
+ * wire surface as the reference MerkleKV, so it works against either
+ * server). Zero dependencies; thread-safe (commands serialize on the
+ * connection); {@link Pipeline} batches commands into one write.
+ *
+ * <pre>{@code
+ * try (MerkleKVClient c = MerkleKVClient.connect("127.0.0.1", 7379)) {
+ *     c.set("user:1", "alice");
+ *     Optional<String> v = c.get("user:1");
+ *     c.incr("visits", 1);
+ *     String root = c.hash();
+ * }
+ * }</pre>
+ */
+public final class MerkleKVClient implements AutoCloseable {
+
+    /** Server rejected a command with an ERROR line. */
+    public static final class ServerException extends IOException {
+        public ServerException(String msg) { super(msg); }
+    }
+
+    private final Socket socket;
+    private final BufferedReader reader;
+    private final OutputStream out;
+    private final Object lock = new Object();
+
+    private MerkleKVClient(Socket socket) throws IOException {
+        this.socket = socket;
+        this.reader = new BufferedReader(
+            new InputStreamReader(socket.getInputStream(), StandardCharsets.UTF_8));
+        this.out = socket.getOutputStream();
+    }
+
+    /** Default host/port from MERKLEKV_HOST / MERKLEKV_PORT (127.0.0.1:7379). */
+    public static MerkleKVClient connect() throws IOException {
+        String host = System.getenv().getOrDefault("MERKLEKV_HOST", "127.0.0.1");
+        int port = Integer.parseInt(
+            System.getenv().getOrDefault("MERKLEKV_PORT", "7379"));
+        return connect(host, port);
+    }
+
+    public static MerkleKVClient connect(String host, int port) throws IOException {
+        return connect(host, port, 5000);
+    }
+
+    public static MerkleKVClient connect(String host, int port, int timeoutMs)
+            throws IOException {
+        Socket s = new Socket();
+        s.connect(new InetSocketAddress(host, port), timeoutMs);
+        s.setTcpNoDelay(true);
+        s.setSoTimeout(timeoutMs);
+        return new MerkleKVClient(s);
+    }
+
+    @Override
+    public void close() throws IOException { socket.close(); }
+
+    private static void checkArg(String s) {
+        if (s.indexOf('\r') >= 0 || s.indexOf('\n') >= 0) {
+            throw new IllegalArgumentException("CR/LF forbidden in arguments");
+        }
+    }
+
+    private String readLine() throws IOException {
+        String line = reader.readLine();
+        if (line == null) throw new IOException("connection closed");
+        return line;
+    }
+
+    private String command(String line) throws IOException {
+        checkArg(line);
+        synchronized (lock) {
+            out.write((line + "\r\n").getBytes(StandardCharsets.UTF_8));
+            out.flush();
+            String resp = readLine();
+            if (resp.startsWith("ERROR ")) {
+                throw new ServerException(resp.substring(6));
+            }
+            return resp;
+        }
+    }
+
+    // ---- basic ops --------------------------------------------------------
+
+    public Optional<String> get(String key) throws IOException {
+        String resp = command("GET " + key);
+        if (resp.equals("NOT_FOUND")) return Optional.empty();
+        require(resp.startsWith("VALUE "), "GET", resp);
+        return Optional.of(resp.substring(6));
+    }
+
+    public void set(String key, String value) throws IOException {
+        String resp = command("SET " + key + " " + value);
+        require(resp.equals("OK"), "SET", resp);
+    }
+
+    /** @return true when the key existed. */
+    public boolean delete(String key) throws IOException {
+        return command("DEL " + key).equals("DELETED");
+    }
+
+    // ---- numeric / string ops --------------------------------------------
+
+    public long incr(String key, long delta) throws IOException {
+        return parseValue(command("INC " + key + " " + delta));
+    }
+
+    public long decr(String key, long delta) throws IOException {
+        return parseValue(command("DEC " + key + " " + delta));
+    }
+
+    public String append(String key, String value) throws IOException {
+        String resp = command("APPEND " + key + " " + value);
+        require(resp.startsWith("VALUE "), "APPEND", resp);
+        return resp.substring(6);
+    }
+
+    public String prepend(String key, String value) throws IOException {
+        String resp = command("PREPEND " + key + " " + value);
+        require(resp.startsWith("VALUE "), "PREPEND", resp);
+        return resp.substring(6);
+    }
+
+    // ---- bulk / query ops -------------------------------------------------
+
+    /** Found keys only; missing keys are absent from the map. */
+    public Map<String, String> mget(List<String> keys) throws IOException {
+        Map<String, String> result = new LinkedHashMap<>();
+        if (keys.isEmpty()) return result;
+        synchronized (lock) {
+            String cmd = "MGET " + String.join(" ", keys);
+            checkArg(cmd);
+            out.write((cmd + "\r\n").getBytes(StandardCharsets.UTF_8));
+            out.flush();
+            String first = readLine();
+            if (first.startsWith("ERROR ")) throw new ServerException(first.substring(6));
+            if (first.equals("NOT_FOUND")) return result;
+            require(first.startsWith("VALUES "), "MGET", first);
+            for (int i = 0; i < keys.size(); i++) {
+                String line = readLine();
+                int sp = line.indexOf(' ');
+                if (sp < 0) continue;
+                String k = line.substring(0, sp);
+                String v = line.substring(sp + 1);
+                if (!v.equals("NOT_FOUND")) result.put(k, v);
+            }
+        }
+        return result;
+    }
+
+    /** Values must not contain whitespace (MSET splits on runs); use set(). */
+    public void mset(Map<String, String> pairs) throws IOException {
+        if (pairs.isEmpty()) return;
+        StringBuilder sb = new StringBuilder("MSET");
+        for (Map.Entry<String, String> e : pairs.entrySet()) {
+            if (e.getValue().matches(".*\\s.*")) {
+                throw new IllegalArgumentException(
+                    "MSET values must not contain whitespace");
+            }
+            sb.append(' ').append(e.getKey()).append(' ').append(e.getValue());
+        }
+        String resp = command(sb.toString());
+        require(resp.equals("OK"), "MSET", resp);
+    }
+
+    public int exists(List<String> keys) throws IOException {
+        String resp = command("EXISTS " + String.join(" ", keys));
+        require(resp.startsWith("EXISTS "), "EXISTS", resp);
+        return Integer.parseInt(resp.substring(7));
+    }
+
+    /** Sorted keys with the prefix ("" = all). */
+    public List<String> scan(String prefix) throws IOException {
+        List<String> keys = new ArrayList<>();
+        synchronized (lock) {
+            String cmd = prefix.isEmpty() ? "SCAN" : "SCAN " + prefix;
+            checkArg(cmd);
+            out.write((cmd + "\r\n").getBytes(StandardCharsets.UTF_8));
+            out.flush();
+            String first = readLine();
+            if (first.startsWith("ERROR ")) throw new ServerException(first.substring(6));
+            require(first.startsWith("KEYS "), "SCAN", first);
+            int n = Integer.parseInt(first.substring(5));
+            for (int i = 0; i < n; i++) keys.add(readLine());
+        }
+        return keys;
+    }
+
+    public long dbsize() throws IOException {
+        String resp = command("DBSIZE");
+        require(resp.startsWith("DBSIZE "), "DBSIZE", resp);
+        return Long.parseLong(resp.substring(7));
+    }
+
+    /** Hex SHA-256 Merkle root of the keyspace (64 zeros when empty). */
+    public String hash() throws IOException {
+        String resp = command("HASH");
+        String[] fields = resp.split(" ");
+        require(fields.length >= 2 && fields[0].equals("HASH"), "HASH", resp);
+        return fields[fields.length - 1];
+    }
+
+    public void truncate() throws IOException {
+        String resp = command("TRUNCATE");
+        require(resp.equals("OK"), "TRUNCATE", resp);
+    }
+
+    // ---- admin ------------------------------------------------------------
+
+    public String ping(String msg) throws IOException {
+        String resp = command(msg.isEmpty() ? "PING" : "PING " + msg);
+        require(resp.startsWith("PONG"), "PING", resp);
+        return resp.length() > 5 ? resp.substring(5) : "";
+    }
+
+    public boolean healthCheck() {
+        try {
+            ping("health");
+            return true;
+        } catch (IOException e) {
+            return false;
+        }
+    }
+
+    public Map<String, String> stats() throws IOException {
+        Map<String, String> result = new HashMap<>();
+        synchronized (lock) {
+            out.write("STATS\r\n".getBytes(StandardCharsets.UTF_8));
+            out.flush();
+            String first = readLine();
+            require(first.equals("STATS"), "STATS", first);
+            for (String line = readLine(); !line.equals("END"); line = readLine()) {
+                int c = line.indexOf(':');
+                if (c > 0) result.put(line.substring(0, c), line.substring(c + 1));
+            }
+        }
+        return result;
+    }
+
+    public String version() throws IOException {
+        String resp = command("VERSION");
+        require(resp.startsWith("VERSION "), "VERSION", resp);
+        return resp.substring(8);
+    }
+
+    // ---- pipeline ---------------------------------------------------------
+
+    /** Batches single-line-response commands into one socket write. */
+    public final class Pipeline {
+        private final List<String> cmds = new ArrayList<>();
+
+        public Pipeline set(String key, String value) {
+            cmds.add("SET " + key + " " + value);
+            return this;
+        }
+
+        public Pipeline get(String key) {
+            cmds.add("GET " + key);
+            return this;
+        }
+
+        public Pipeline delete(String key) {
+            cmds.add("DEL " + key);
+            return this;
+        }
+
+        /** @return raw response line per queued command, in order. */
+        public List<String> exec() throws IOException {
+            List<String> resps = new ArrayList<>(cmds.size());
+            if (cmds.isEmpty()) return resps;
+            for (String c : cmds) checkArg(c);
+            synchronized (lock) {
+                StringBuilder sb = new StringBuilder();
+                for (String c : cmds) sb.append(c).append("\r\n");
+                out.write(sb.toString().getBytes(StandardCharsets.UTF_8));
+                out.flush();
+                for (int i = 0; i < cmds.size(); i++) resps.add(readLine());
+            }
+            cmds.clear();
+            return resps;
+        }
+    }
+
+    public Pipeline pipeline() { return new Pipeline(); }
+
+    // ---- helpers ----------------------------------------------------------
+
+    private static long parseValue(String resp) throws IOException {
+        if (!resp.startsWith("VALUE ")) {
+            throw new IOException("unexpected response: " + resp);
+        }
+        return Long.parseLong(resp.substring(6));
+    }
+
+    private static void require(boolean ok, String verb, String resp)
+            throws IOException {
+        if (!ok) throw new IOException("unexpected " + verb + " response: " + resp);
+    }
+}

@@ -1,0 +1,75 @@
+package io.merklekv.client;
+
+import java.util.List;
+import java.util.Map;
+import java.util.Optional;
+
+/**
+ * Self-contained integration test (no JUnit dependency — CI compiles with
+ * javac and runs this main against a live server; exits non-zero on any
+ * failure, prints SKIP when no server is reachable).
+ */
+public final class ClientSelfTest {
+
+    private static int checks = 0;
+
+    private static void check(boolean ok, String what) {
+        checks++;
+        if (!ok) {
+            System.err.println("FAIL: " + what);
+            System.exit(1);
+        }
+    }
+
+    public static void main(String[] args) throws Exception {
+        MerkleKVClient c;
+        try {
+            c = MerkleKVClient.connect();
+        } catch (Exception e) {
+            System.out.println("SKIP: no server reachable: " + e);
+            return;
+        }
+        try (c) {
+            c.set("java:k1", "v1");
+            check(c.get("java:k1").equals(Optional.of("v1")), "get after set");
+            check(c.delete("java:k1"), "delete existing");
+            check(c.get("java:k1").isEmpty(), "get after delete");
+            check(!c.delete("java:k1"), "delete missing");
+
+            String spaced = "hello world\twith tab";
+            c.set("java:sp", spaced);
+            check(c.get("java:sp").equals(Optional.of(spaced)), "value with spaces");
+
+            c.delete("java:n");
+            check(c.incr("java:n", 5) == 5, "incr creates");
+            check(c.decr("java:n", 2) == 3, "decr");
+            c.delete("java:s");
+            check(c.append("java:s", "ab").equals("ab"), "append creates");
+            check(c.prepend("java:s", "x").equals("xab"), "prepend");
+
+            c.mset(Map.of("java:m1", "a", "java:m2", "b"));
+            Map<String, String> got = c.mget(List.of("java:m1", "java:m2", "java:nope"));
+            check(got.size() == 2 && got.get("java:m1").equals("a"), "mget");
+            check(c.exists(List.of("java:m1", "java:m2", "java:nope")) == 2, "exists");
+            List<String> keys = c.scan("java:m");
+            check(keys.equals(List.of("java:m1", "java:m2")), "scan sorted");
+
+            String h1 = c.hash();
+            check(h1.length() == 64, "hash shape");
+            c.set("java:hk", String.valueOf(System.nanoTime()));
+            check(!c.hash().equals(h1), "hash changes with writes");
+
+            List<String> resps = c.pipeline()
+                .set("java:p1", "1").set("java:p2", "2")
+                .get("java:p1").delete("java:p2").exec();
+            check(resps.equals(List.of("OK", "OK", "VALUE 1", "DELETED")),
+                "pipeline " + resps);
+
+            check(c.healthCheck(), "health check");
+            check(c.stats().containsKey("total_commands"), "stats");
+            check(c.version().contains("."), "version");
+            check(c.dbsize() >= 0, "dbsize");
+        }
+        System.out.println("JAVA CLIENT PASS (" + checks + " checks)");
+    }
+}

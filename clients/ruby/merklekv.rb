@@ -1,0 +1,266 @@
+# Ruby client for the merklekv_tpu text protocol (docs/PROTOCOL.md; same
+# wire surface as the reference MerkleKV, so it works against either
+# server). Stdlib-only; thread-safe (commands serialize on a mutex);
+# +pipeline+ batches commands into one write.
+#
+#   client = MerkleKV::Client.new(host: "127.0.0.1", port: 7379)
+#   client.set("user:1", "alice")
+#   client.get("user:1")      # => "alice"
+#   client.incr("visits")     # => 1
+#   client.hash               # => hex Merkle root
+#   client.close
+
+require "socket"
+
+module MerkleKV
+  class Error < StandardError; end
+  # Server answered with an ERROR line.
+  class ServerError < Error; end
+  # Command round-trip exceeded the configured timeout.
+  class TimeoutError < Error; end
+
+  class Client
+    DEFAULT_PORT = 7379
+
+    def self.default_host = ENV.fetch("MERKLEKV_HOST", "127.0.0.1")
+    def self.default_port = Integer(ENV.fetch("MERKLEKV_PORT", DEFAULT_PORT.to_s))
+
+    def initialize(host: nil, port: nil, timeout: 5.0)
+      @host = host || self.class.default_host
+      @port = port || self.class.default_port
+      @timeout = timeout
+      @mutex = Mutex.new
+      @buf = +""
+      @sock = Socket.tcp(@host, @port, connect_timeout: timeout)
+      @sock.setsockopt(Socket::IPPROTO_TCP, Socket::TCP_NODELAY, 1)
+    end
+
+    def close
+      @sock&.close
+      @sock = nil
+    end
+
+    # -- basic ops ----------------------------------------------------------
+
+    # Returns the value, or nil when the key is missing.
+    def get(key)
+      resp = command("GET #{key}")
+      return nil if resp == "NOT_FOUND"
+      expect_prefix(resp, "VALUE ", "GET")
+    end
+
+    def set(key, value)
+      resp = command("SET #{key} #{value}")
+      raise ServerError, "unexpected SET response: #{resp}" unless resp == "OK"
+      true
+    end
+
+    # Returns true when the key existed.
+    def delete(key)
+      command("DEL #{key}") == "DELETED"
+    end
+
+    # -- numeric / string ops -----------------------------------------------
+
+    def incr(key, delta = 1)
+      Integer(expect_prefix(command("INC #{key} #{delta}"), "VALUE ", "INC"))
+    end
+
+    def decr(key, delta = 1)
+      Integer(expect_prefix(command("DEC #{key} #{delta}"), "VALUE ", "DEC"))
+    end
+
+    def append(key, value)
+      expect_prefix(command("APPEND #{key} #{value}"), "VALUE ", "APPEND")
+    end
+
+    def prepend(key, value)
+      expect_prefix(command("PREPEND #{key} #{value}"), "VALUE ", "PREPEND")
+    end
+
+    # -- bulk / query ops ---------------------------------------------------
+
+    # Hash of found keys only.
+    def mget(*keys)
+      return {} if keys.empty?
+      lines = command_multi("MGET #{keys.join(' ')}") do |first|
+        next 0 if first == "NOT_FOUND"
+        unless first.start_with?("VALUES ")
+          raise ServerError, "unexpected MGET response: #{first}"
+        end
+        keys.length
+      end
+      out = {}
+      return out if lines.first == "NOT_FOUND"
+      lines.drop(1).each do |line|
+        k, v = line.split(" ", 2)
+        out[k] = v unless v.nil? || v == "NOT_FOUND"
+      end
+      out
+    end
+
+    # Values must not contain whitespace (MSET splits on runs); use +set+.
+    def mset(pairs)
+      return true if pairs.empty?
+      parts = pairs.flat_map do |k, v|
+        raise ArgumentError, "MSET values must not contain whitespace" if v =~ /\s/
+        [k, v]
+      end
+      resp = command("MSET #{parts.join(' ')}")
+      raise ServerError, "unexpected MSET response: #{resp}" unless resp == "OK"
+      true
+    end
+
+    def exists(*keys)
+      Integer(expect_prefix(command("EXISTS #{keys.join(' ')}"), "EXISTS ", "EXISTS"))
+    end
+
+    # Sorted keys with the prefix ("" = all).
+    def scan(prefix = "")
+      cmd = prefix.empty? ? "SCAN" : "SCAN #{prefix}"
+      lines = command_multi(cmd) do |first|
+        unless first.start_with?("KEYS ")
+          raise ServerError, "unexpected SCAN response: #{first}"
+        end
+        Integer(first[5..])
+      end
+      lines.drop(1)
+    end
+
+    def dbsize
+      Integer(expect_prefix(command("DBSIZE"), "DBSIZE ", "DBSIZE"))
+    end
+
+    # Hex SHA-256 Merkle root of the keyspace (64 zeros when empty).
+    # Named merkle_root, NOT hash: overriding Object#hash with a network
+    # call returning a String would break using the client as a Hash key.
+    def merkle_root(pattern = "")
+      cmd = pattern.empty? ? "HASH" : "HASH #{pattern}"
+      resp = command(cmd)
+      fields = resp.split(" ")
+      unless fields.first == "HASH" && fields.length >= 2
+        raise ServerError, "unexpected HASH response: #{resp}"
+      end
+      fields.last
+    end
+
+    def truncate
+      resp = command("TRUNCATE")
+      raise ServerError, "unexpected TRUNCATE response: #{resp}" unless resp == "OK"
+      true
+    end
+
+    # -- admin --------------------------------------------------------------
+
+    def ping(msg = "")
+      resp = command(msg.empty? ? "PING" : "PING #{msg}")
+      raise ServerError, "unexpected PING response: #{resp}" unless resp.start_with?("PONG")
+      resp.sub(/\APONG ?/, "")
+    end
+
+    def health_check
+      ping("health")
+      true
+    rescue Error, SystemCallError
+      false
+    end
+
+    def stats
+      @mutex.synchronize do
+        write_line("STATS")
+        first = read_line
+        raise ServerError, "unexpected STATS response: #{first}" unless first == "STATS"
+        out = {}
+        loop do
+          line = read_line
+          return out if line == "END"
+          k, v = line.split(":", 2)
+          out[k] = v if v
+        end
+      end
+    end
+
+    def version
+      expect_prefix(command("VERSION"), "VERSION ", "VERSION")
+    end
+
+    # -- pipeline -----------------------------------------------------------
+
+    # Batches single-line-response commands into one write:
+    #   resps = client.pipeline { |p| p.set("a", "1"); p.get("a") }
+    def pipeline
+      p = Pipeline.new
+      yield p
+      cmds = p.commands
+      return [] if cmds.empty?
+      cmds.each { |c| check_arg(c) }
+      @mutex.synchronize do
+        @sock.write(cmds.map { |c| "#{c}\r\n" }.join)
+        cmds.map { read_line }
+      end
+    end
+
+    class Pipeline
+      attr_reader :commands
+
+      def initialize = @commands = []
+      def set(key, value) = @commands << "SET #{key} #{value}"
+      def get(key) = @commands << "GET #{key}"
+      def delete(key) = @commands << "DEL #{key}"
+    end
+
+    private
+
+    def check_arg(line)
+      raise ArgumentError, "CR/LF forbidden in arguments" if line =~ /[\r\n]/
+    end
+
+    def write_line(line)
+      check_arg(line)
+      @sock.write("#{line}\r\n")
+    end
+
+    def read_line
+      deadline = Process.clock_gettime(Process::CLOCK_MONOTONIC) + @timeout
+      until (idx = @buf.index("\n"))
+        remaining = deadline - Process.clock_gettime(Process::CLOCK_MONOTONIC)
+        raise TimeoutError, "timed out after #{@timeout}s" if remaining <= 0
+        unless @sock.wait_readable(remaining)
+          raise TimeoutError, "timed out after #{@timeout}s"
+        end
+        chunk = @sock.recv_nonblock(65536, exception: false)
+        raise Error, "connection closed" if chunk.nil? || chunk == ""
+        @buf << chunk unless chunk == :wait_readable
+      end
+      # recv chunks arrive binary; the protocol is UTF-8 text, and callers
+      # compare against UTF-8 literals (ASCII-8BIT "café" != UTF-8 "café").
+      @buf.slice!(0..idx).chomp("\n").chomp("\r").force_encoding(Encoding::UTF_8)
+    end
+
+    def command(line)
+      @mutex.synchronize do
+        write_line(line)
+        resp = read_line
+        raise ServerError, resp[6..] if resp.start_with?("ERROR ")
+        resp
+      end
+    end
+
+    def command_multi(line)
+      @mutex.synchronize do
+        write_line(line)
+        first = read_line
+        raise ServerError, first[6..] if first.start_with?("ERROR ")
+        extra = yield first
+        [first] + Array.new(extra) { read_line }
+      end
+    end
+
+    def expect_prefix(resp, prefix, verb)
+      unless resp.start_with?(prefix)
+        raise ServerError, "unexpected #{verb} response: #{resp}"
+      end
+      resp[prefix.length..]
+    end
+  end
+end

@@ -1,0 +1,77 @@
+# Integration tests (minitest, stdlib) against a live server. CI starts one
+# and exports MERKLEKV_PORT; without a reachable server every test skips.
+require "minitest/autorun"
+require_relative "merklekv"
+
+class TestMerkleKV < Minitest::Test
+  def setup
+    @c = MerkleKV::Client.new(timeout: 10.0)
+  rescue StandardError => e
+    skip "no server reachable: #{e}"
+  end
+
+  def teardown
+    @c&.close
+  end
+
+  def test_set_get_delete
+    @c.set("rb:k1", "v1")
+    assert_equal "v1", @c.get("rb:k1")
+    assert_equal true, @c.delete("rb:k1")
+    assert_nil @c.get("rb:k1")
+    assert_equal false, @c.delete("rb:k1")
+  end
+
+  def test_values_with_spaces_and_tabs
+    val = "hello world\twith tab"
+    @c.set("rb:sp", val)
+    assert_equal val, @c.get("rb:sp")
+  end
+
+  def test_numeric_and_splice
+    @c.delete("rb:n")
+    assert_equal 5, @c.incr("rb:n", 5)
+    assert_equal 3, @c.decr("rb:n", 2)
+    @c.delete("rb:s")
+    assert_equal "ab", @c.append("rb:s", "ab")
+    assert_equal "xab", @c.prepend("rb:s", "x")
+  end
+
+  def test_mget_mset_scan_exists
+    @c.mset("rb:m1" => "a", "rb:m2" => "b")
+    got = @c.mget("rb:m1", "rb:m2", "rb:nope")
+    assert_equal({ "rb:m1" => "a", "rb:m2" => "b" }, got)
+    assert_equal 2, @c.exists("rb:m1", "rb:m2", "rb:nope")
+    assert_equal %w[rb:m1 rb:m2], @c.scan("rb:m")
+  end
+
+  def test_hash_changes_with_writes
+    h1 = @c.merkle_root
+    assert_equal 64, h1.length
+    @c.set("rb:hk", Time.now.to_f.to_s)
+    refute_equal h1, @c.merkle_root
+  end
+
+  def test_pipeline
+    resps = @c.pipeline do |p|
+      p.set("rb:p1", "1")
+      p.set("rb:p2", "2")
+      p.get("rb:p1")
+      p.delete("rb:p2")
+    end
+    assert_equal ["OK", "OK", "VALUE 1", "DELETED"], resps
+  end
+
+  def test_stats_health_version
+    assert @c.health_check
+    assert @c.stats.key?("total_commands")
+    assert_includes @c.version, "."
+    assert_operator @c.dbsize, :>=, 0
+  end
+
+  def test_server_error_raises
+    @c.set("rb:notnum", "abc")
+    err = assert_raises(MerkleKV::ServerError) { @c.incr("rb:notnum", 1) }
+    assert_match(/not a valid number/, err.message)
+  end
+end

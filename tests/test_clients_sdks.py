@@ -61,3 +61,43 @@ def test_node_client_suite(server_port):
         timeout=300,
     )
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.integration
+def test_java_client_suite(server_port, tmp_path):
+    javac = shutil.which("javac")
+    if javac is None or shutil.which("java") is None:
+        pytest.skip("java toolchain not installed")
+    jdir = os.path.join(REPO, "clients", "java")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [javac, "-d", str(tmp_path),
+         os.path.join(jdir, "src/main/java/io/merklekv/client/MerkleKVClient.java"),
+         os.path.join(jdir, "src/test/java/io/merklekv/client/ClientSelfTest.java")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        ["java", "-cp", str(tmp_path), "io.merklekv.client.ClientSelfTest"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "JAVA CLIENT PASS" in r.stdout, r.stdout
+
+
+@pytest.mark.integration
+def test_ruby_client_suite(server_port):
+    ruby = shutil.which("ruby")
+    if ruby is None:
+        pytest.skip("ruby toolchain not installed")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [ruby, "test_merklekv.rb"],
+        cwd=os.path.join(REPO, "clients", "ruby"),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 failures, 0 errors, 0 skips" in r.stdout, r.stdout
