@@ -1,0 +1,101 @@
+// Self-test against a live server. CI starts one and exports MERKLEKV_PORT;
+// without a reachable server the program exits 0 with a SKIP line. Prints
+// "DOTNET CLIENT PASS" and exits 0 on success; exits 1 on first failure.
+//
+// Build + run (no project file needed beyond merklekv.csproj):
+//   dotnet run --project clients/dotnet
+
+using System;
+using System.Linq;
+using MerkleKV;
+
+internal static class ClientSelfTest
+{
+    private static void Check(bool cond, string what)
+    {
+        if (!cond)
+        {
+            Console.Error.WriteLine($"FAIL: {what}");
+            Environment.Exit(1);
+        }
+        Console.WriteLine($"ok - {what}");
+    }
+
+    private static int Main()
+    {
+        Client c;
+        try
+        {
+            c = new Client(timeoutSeconds: 10.0);
+        }
+        catch (Exception e)
+        {
+            Console.WriteLine($"SKIP: no server reachable: {e.Message}");
+            return 0;
+        }
+
+        using (c)
+        {
+            c.Set("cs:k1", "v1");
+            Check(c.Get("cs:k1") == "v1", "set/get");
+            Check(c.Delete("cs:k1"), "delete existing");
+            Check(c.Get("cs:k1") == null, "get after delete");
+            Check(!c.Delete("cs:k1"), "delete missing");
+
+            var val = "hello world\twith tab";
+            c.Set("cs:sp", val);
+            Check(c.Get("cs:sp") == val, "value with space+tab");
+
+            c.Delete("cs:n");
+            Check(c.Incr("cs:n", 5) == 5, "incr creates");
+            Check(c.Decr("cs:n", 2) == 3, "decr");
+            c.Delete("cs:s");
+            Check(c.Append("cs:s", "ab") == "ab", "append creates");
+            Check(c.Prepend("cs:s", "x") == "xab", "prepend");
+
+            c.MSet(new System.Collections.Generic.Dictionary<string, string>
+            {
+                ["cs:m1"] = "a",
+                ["cs:m2"] = "b",
+            });
+            var got = c.MGet("cs:m1", "cs:m2", "cs:nope");
+            Check(got.Count == 2 && got["cs:m1"] == "a" && got["cs:m2"] == "b", "mset/mget");
+            Check(c.Exists("cs:m1", "cs:m2", "cs:nope") == 2, "exists");
+            Check(c.Scan("cs:m").SequenceEqual(new[] { "cs:m1", "cs:m2" }), "scan prefix sorted");
+
+            var h1 = c.MerkleRoot();
+            Check(h1.Length == 64, "merkle root is 64 hex chars");
+            c.Set("cs:hk", DateTime.UtcNow.Ticks.ToString());
+            Check(c.MerkleRoot() != h1, "root changes after write");
+
+            var resps = c.RunPipeline(p =>
+            {
+                p.Set("cs:p1", "1");
+                p.Set("cs:p2", "2");
+                p.Get("cs:p1");
+                p.Delete("cs:p2");
+            });
+            Check(resps.SequenceEqual(new[] { "OK", "OK", "VALUE 1", "DELETED" }), "pipeline");
+
+            Check(c.HealthCheck(), "health check");
+            Check(c.Stats().ContainsKey("total_commands"), "stats has total_commands");
+            Check(c.Version().Contains('.'), "version has a dot");
+            Check(c.DbSize() >= 0, "dbsize");
+
+            c.Set("cs:notnum", "abc");
+            var threw = false;
+            try
+            {
+                c.Incr("cs:notnum", 1);
+            }
+            catch (ServerException e)
+            {
+                threw = e.Message.Contains("not a valid number");
+            }
+            Check(threw, "INC on non-numeric raises ServerException");
+        }
+
+        Console.WriteLine("DOTNET CLIENT PASS");
+        return 0;
+    }
+}

@@ -1,0 +1,350 @@
+// C# client for the merklekv_tpu text protocol (docs/PROTOCOL.md; the same
+// wire surface as the reference MerkleKV, so it works against either
+// server). BCL-only; thread-safe (commands serialize on an instance lock);
+// Pipeline batches commands into one write.
+//
+//   using var c = new MerkleKV.Client("127.0.0.1", 7379);
+//   c.Set("user:1", "alice");
+//   c.Get("user:1");      // "alice"
+//   c.Incr("visits");     // 1
+//   c.MerkleRoot();       // hex Merkle root
+
+using System;
+using System.Collections.Generic;
+using System.Diagnostics;
+using System.Net.Sockets;
+using System.Text;
+
+namespace MerkleKV
+{
+    public class MerkleKVException : Exception
+    {
+        public MerkleKVException(string message) : base(message) { }
+    }
+
+    /// <summary>Server answered with an ERROR line.</summary>
+    public class ServerException : MerkleKVException
+    {
+        public ServerException(string message) : base(message) { }
+    }
+
+    /// <summary>Command round-trip exceeded the configured timeout.</summary>
+    public class TimeoutException : MerkleKVException
+    {
+        public TimeoutException(string message) : base(message) { }
+    }
+
+    public sealed class Client : IDisposable
+    {
+        public const int DefaultPort = 7379;
+
+        private readonly TcpClient _tcp;
+        private readonly NetworkStream _stream;
+        private readonly object _lock = new object();
+        private readonly double _timeoutSeconds;
+        private byte[] _buf = Array.Empty<byte>();
+        private int _bufLen;
+
+        public static string DefaultHost =>
+            Environment.GetEnvironmentVariable("MERKLEKV_HOST") ?? "127.0.0.1";
+
+        public static int DefaultPortFromEnv =>
+            int.TryParse(Environment.GetEnvironmentVariable("MERKLEKV_PORT"), out var p)
+                ? p : DefaultPort;
+
+        public Client(string? host = null, int? port = null, double timeoutSeconds = 5.0)
+        {
+            host ??= DefaultHost;
+            var resolvedPort = port ?? DefaultPortFromEnv;
+            _timeoutSeconds = timeoutSeconds;
+            _tcp = new TcpClient();
+            if (!_tcp.ConnectAsync(host, resolvedPort).Wait(TimeSpan.FromSeconds(timeoutSeconds)))
+            {
+                _tcp.Close();
+                throw new TimeoutException($"connect to {host}:{resolvedPort} timed out");
+            }
+            _tcp.NoDelay = true;
+            _tcp.ReceiveTimeout = (int)(timeoutSeconds * 1000);
+            _tcp.SendTimeout = (int)(timeoutSeconds * 1000);
+            _stream = _tcp.GetStream();
+        }
+
+        public void Dispose()
+        {
+            _stream.Dispose();
+            _tcp.Close();
+        }
+
+        // -- basic ops ------------------------------------------------------
+
+        /// <summary>Returns the value, or null when the key is missing.</summary>
+        public string? Get(string key)
+        {
+            var resp = Command($"GET {key}");
+            if (resp == "NOT_FOUND") return null;
+            return ExpectPrefix(resp, "VALUE ", "GET");
+        }
+
+        public void Set(string key, string value)
+        {
+            var resp = Command($"SET {key} {value}");
+            if (resp != "OK") throw new ServerException($"unexpected SET response: {resp}");
+        }
+
+        /// <summary>Returns true when the key existed.</summary>
+        public bool Delete(string key) => Command($"DEL {key}") == "DELETED";
+
+        // -- numeric / string ops -------------------------------------------
+
+        public long Incr(string key, long delta = 1) =>
+            long.Parse(ExpectPrefix(Command($"INC {key} {delta}"), "VALUE ", "INC"));
+
+        public long Decr(string key, long delta = 1) =>
+            long.Parse(ExpectPrefix(Command($"DEC {key} {delta}"), "VALUE ", "DEC"));
+
+        public string Append(string key, string value) =>
+            ExpectPrefix(Command($"APPEND {key} {value}"), "VALUE ", "APPEND");
+
+        public string Prepend(string key, string value) =>
+            ExpectPrefix(Command($"PREPEND {key} {value}"), "VALUE ", "PREPEND");
+
+        // -- bulk / query ops -----------------------------------------------
+
+        /// <summary>Dictionary of found keys only (missing keys omitted).</summary>
+        public Dictionary<string, string> MGet(params string[] keys)
+        {
+            var outMap = new Dictionary<string, string>();
+            if (keys.Length == 0) return outMap;
+            lock (_lock)
+            {
+                WriteLine($"MGET {string.Join(" ", keys)}");
+                var first = ReadLineRaiseError();
+                if (first == "NOT_FOUND") return outMap;
+                if (!first.StartsWith("VALUES "))
+                    throw new ServerException($"unexpected MGET response: {first}");
+                foreach (var _ in keys)
+                {
+                    var line = ReadLine();
+                    var sp = line.IndexOf(' ');
+                    if (sp < 0) continue;
+                    var v = line[(sp + 1)..];
+                    if (v != "NOT_FOUND") outMap[line[..sp]] = v;
+                }
+            }
+            return outMap;
+        }
+
+        /// <summary>Values must not contain whitespace (MSET splits on runs); use Set.</summary>
+        public void MSet(IReadOnlyDictionary<string, string> pairs)
+        {
+            if (pairs.Count == 0) return;
+            var parts = new List<string>(pairs.Count * 2);
+            foreach (var (k, v) in pairs)
+            {
+                foreach (var ch in v)
+                    if (char.IsWhiteSpace(ch))
+                        throw new ArgumentException("MSET values must not contain whitespace");
+                parts.Add(k);
+                parts.Add(v);
+            }
+            var resp = Command($"MSET {string.Join(" ", parts)}");
+            if (resp != "OK") throw new ServerException($"unexpected MSET response: {resp}");
+        }
+
+        public long Exists(params string[] keys) =>
+            long.Parse(ExpectPrefix(Command($"EXISTS {string.Join(" ", keys)}"), "EXISTS ", "EXISTS"));
+
+        /// <summary>Sorted keys with the prefix ("" = all).</summary>
+        public List<string> Scan(string prefix = "")
+        {
+            var cmd = prefix.Length == 0 ? "SCAN" : $"SCAN {prefix}";
+            var result = new List<string>();
+            lock (_lock)
+            {
+                WriteLine(cmd);
+                var first = ReadLineRaiseError();
+                if (!first.StartsWith("KEYS "))
+                    throw new ServerException($"unexpected SCAN response: {first}");
+                var n = int.Parse(first[5..]);
+                for (var i = 0; i < n; i++) result.Add(ReadLine());
+            }
+            return result;
+        }
+
+        public long DbSize() =>
+            long.Parse(ExpectPrefix(Command("DBSIZE"), "DBSIZE ", "DBSIZE"));
+
+        /// <summary>Hex SHA-256 Merkle root of the keyspace (64 zeros when empty).</summary>
+        public string MerkleRoot(string pattern = "")
+        {
+            var cmd = pattern.Length == 0 ? "HASH" : $"HASH {pattern}";
+            var resp = Command(cmd);
+            var fields = resp.Split(' ');
+            if (fields.Length < 2 || fields[0] != "HASH")
+                throw new ServerException($"unexpected HASH response: {resp}");
+            return fields[^1];
+        }
+
+        public void Truncate()
+        {
+            var resp = Command("TRUNCATE");
+            if (resp != "OK") throw new ServerException($"unexpected TRUNCATE response: {resp}");
+        }
+
+        // -- admin ----------------------------------------------------------
+
+        public string Ping(string msg = "")
+        {
+            var resp = Command(msg.Length == 0 ? "PING" : $"PING {msg}");
+            if (!resp.StartsWith("PONG"))
+                throw new ServerException($"unexpected PING response: {resp}");
+            return resp[4..].TrimStart(' ');
+        }
+
+        public bool HealthCheck()
+        {
+            try
+            {
+                Ping("health");
+                return true;
+            }
+            catch (Exception e) when (e is MerkleKVException || e is SocketException || e is System.IO.IOException)
+            {
+                return false;
+            }
+        }
+
+        public Dictionary<string, string> Stats()
+        {
+            var outMap = new Dictionary<string, string>();
+            lock (_lock)
+            {
+                WriteLine("STATS");
+                var first = ReadLineRaiseError();
+                if (first != "STATS")
+                    throw new ServerException($"unexpected STATS response: {first}");
+                while (true)
+                {
+                    var line = ReadLine();
+                    if (line == "END") return outMap;
+                    var colon = line.IndexOf(':');
+                    if (colon >= 0) outMap[line[..colon]] = line[(colon + 1)..];
+                }
+            }
+        }
+
+        public string Version() =>
+            ExpectPrefix(Command("VERSION"), "VERSION ", "VERSION");
+
+        // -- pipeline -------------------------------------------------------
+
+        /// <summary>
+        /// Batch single-line-response commands into one write; returns one
+        /// raw response line per queued command.
+        /// </summary>
+        public List<string> RunPipeline(Action<Pipeline> build)
+        {
+            var p = new Pipeline();
+            build(p);
+            if (p.Commands.Count == 0) return new List<string>();
+            var payload = new StringBuilder();
+            foreach (var c in p.Commands)
+            {
+                CheckArg(c);
+                payload.Append(c).Append("\r\n");
+            }
+            var result = new List<string>(p.Commands.Count);
+            lock (_lock)
+            {
+                var bytes = Encoding.UTF8.GetBytes(payload.ToString());
+                _stream.Write(bytes, 0, bytes.Length);
+                foreach (var _ in p.Commands) result.Add(ReadLine());
+            }
+            return result;
+        }
+
+        public sealed class Pipeline
+        {
+            internal readonly List<string> Commands = new List<string>();
+
+            public void Set(string key, string value) => Commands.Add($"SET {key} {value}");
+            public void Get(string key) => Commands.Add($"GET {key}");
+            public void Delete(string key) => Commands.Add($"DEL {key}");
+        }
+
+        // -- wire -----------------------------------------------------------
+
+        private static void CheckArg(string line)
+        {
+            if (line.Contains('\r') || line.Contains('\n'))
+                throw new ArgumentException("CR/LF forbidden in arguments");
+        }
+
+        private void WriteLine(string line)
+        {
+            CheckArg(line);
+            var bytes = Encoding.UTF8.GetBytes(line + "\r\n");
+            _stream.Write(bytes, 0, bytes.Length);
+        }
+
+        private string ReadLine()
+        {
+            var deadline = Stopwatch.StartNew();
+            while (true)
+            {
+                var idx = Array.IndexOf(_buf, (byte)'\n', 0, _bufLen);
+                if (idx >= 0)
+                {
+                    var end = idx > 0 && _buf[idx - 1] == (byte)'\r' ? idx - 1 : idx;
+                    var line = Encoding.UTF8.GetString(_buf, 0, end);
+                    Buffer.BlockCopy(_buf, idx + 1, _buf, 0, _bufLen - idx - 1);
+                    _bufLen -= idx + 1;
+                    return line;
+                }
+                if (deadline.Elapsed.TotalSeconds > _timeoutSeconds)
+                    throw new TimeoutException($"timed out after {_timeoutSeconds}s");
+                var chunk = new byte[65536];
+                int n;
+                try
+                {
+                    n = _stream.Read(chunk, 0, chunk.Length);
+                }
+                catch (System.IO.IOException e) when (e.InnerException is SocketException se
+                                                      && se.SocketErrorCode == SocketError.TimedOut)
+                {
+                    throw new TimeoutException($"timed out after {_timeoutSeconds}s");
+                }
+                if (n == 0) throw new MerkleKVException("connection closed");
+                if (_bufLen + n > _buf.Length)
+                {
+                    Array.Resize(ref _buf, Math.Max(_buf.Length * 2, _bufLen + n));
+                }
+                Buffer.BlockCopy(chunk, 0, _buf, _bufLen, n);
+                _bufLen += n;
+            }
+        }
+
+        private string ReadLineRaiseError()
+        {
+            var resp = ReadLine();
+            if (resp.StartsWith("ERROR ")) throw new ServerException(resp[6..]);
+            return resp;
+        }
+
+        private string Command(string line)
+        {
+            lock (_lock)
+            {
+                WriteLine(line);
+                return ReadLineRaiseError();
+            }
+        }
+
+        private static string ExpectPrefix(string resp, string prefix, string verb)
+        {
+            if (!resp.StartsWith(prefix))
+                throw new ServerException($"unexpected {verb} response: {resp}");
+            return resp[prefix.Length..];
+        }
+    }
+}

@@ -1,0 +1,352 @@
+defmodule MerkleKV do
+  @moduledoc """
+  Elixir client for the merklekv_tpu text protocol (docs/PROTOCOL.md; the
+  same wire surface as the reference MerkleKV, so it works against either
+  server). Stdlib-only (`:gen_tcp`); one connection per client struct.
+  The struct is owned by the process that called `connect/3`: response
+  reassembly buffers live in that process (see `read_line/1`), so sharing
+  a struct across processes would misattribute replies — wrap it in a
+  GenServer or pool for shared use. `pipeline/2` batches commands into
+  one write.
+
+      {:ok, c} = MerkleKV.connect("127.0.0.1", 7379)
+      :ok = MerkleKV.set(c, "user:1", "alice")
+      {:ok, "alice"} = MerkleKV.get(c, "user:1")
+      {:ok, 1} = MerkleKV.incr(c, "visits")
+      {:ok, root} = MerkleKV.merkle_root(c)
+      MerkleKV.close(c)
+
+  Command functions return `{:ok, result}` / `:ok`, `{:error, {:server,
+  message}}` for server ERROR lines, `{:error, :timeout}`, or `{:error,
+  reason}` for transport failures. Bang variants are not provided — match
+  on the tuples.
+  """
+
+  defstruct [:sock, :timeout]
+
+  @default_port 7379
+  @type t :: %__MODULE__{sock: :gen_tcp.socket(), timeout: non_neg_integer()}
+
+  def default_host, do: System.get_env("MERKLEKV_HOST", "127.0.0.1")
+
+  def default_port do
+    case System.get_env("MERKLEKV_PORT") do
+      nil -> @default_port
+      p -> String.to_integer(p)
+    end
+  end
+
+  @spec connect(String.t() | nil, integer() | nil, non_neg_integer()) ::
+          {:ok, t()} | {:error, term()}
+  def connect(host \\ nil, port \\ nil, timeout \\ 5_000) do
+    host = host || default_host()
+    port = port || default_port()
+
+    case :gen_tcp.connect(String.to_charlist(host), port, [
+           :binary,
+           active: false,
+           nodelay: true,
+           # line-reassembly is ours; deliver raw chunks
+           packet: :raw
+         ], timeout) do
+      {:ok, sock} -> {:ok, %__MODULE__{sock: sock, timeout: timeout}}
+      {:error, reason} -> {:error, reason}
+    end
+  end
+
+  @spec close(t()) :: :ok
+  def close(%__MODULE__{sock: sock}) do
+    # Reclaim the owning process's reassembly buffer (read_line/1) so a
+    # long-lived process cycling many clients doesn't accumulate entries.
+    Process.delete({__MODULE__, sock})
+    :gen_tcp.close(sock)
+  end
+
+  # -- basic ops ------------------------------------------------------------
+
+  @doc "`{:ok, value}`, `{:ok, nil}` when missing."
+  def get(c, key) do
+    case command(c, "GET #{key}") do
+      {:ok, "NOT_FOUND"} -> {:ok, nil}
+      {:ok, "VALUE " <> v} -> {:ok, v}
+      {:ok, other} -> {:error, {:protocol, "unexpected GET response: #{other}"}}
+      err -> err
+    end
+  end
+
+  def set(c, key, value) do
+    case command(c, "SET #{key} #{value}") do
+      {:ok, "OK"} -> :ok
+      {:ok, other} -> {:error, {:protocol, "unexpected SET response: #{other}"}}
+      err -> err
+    end
+  end
+
+  @doc "`{:ok, true}` when the key existed."
+  def delete(c, key) do
+    case command(c, "DEL #{key}") do
+      {:ok, "DELETED"} -> {:ok, true}
+      {:ok, "NOT_FOUND"} -> {:ok, false}
+      {:ok, other} -> {:error, {:protocol, "unexpected DEL response: #{other}"}}
+      err -> err
+    end
+  end
+
+  # -- numeric / string ops -------------------------------------------------
+
+  def incr(c, key, delta \\ 1), do: int_value(command(c, "INC #{key} #{delta}"), "INC")
+  def decr(c, key, delta \\ 1), do: int_value(command(c, "DEC #{key} #{delta}"), "DEC")
+
+  def append(c, key, value), do: str_value(command(c, "APPEND #{key} #{value}"), "APPEND")
+  def prepend(c, key, value), do: str_value(command(c, "PREPEND #{key} #{value}"), "PREPEND")
+
+  # -- bulk / query ops -----------------------------------------------------
+
+  @doc "Map of found keys only (missing keys omitted)."
+  def mget(_c, []), do: {:ok, %{}}
+
+  def mget(c, keys) when is_list(keys) do
+    with {:ok, first} <- command(c, "MGET #{Enum.join(keys, " ")}") do
+      case first do
+        "NOT_FOUND" ->
+          {:ok, %{}}
+
+        "VALUES " <> _ ->
+          read_kv_lines(c, length(keys), %{})
+
+        other ->
+          {:error, {:protocol, "unexpected MGET response: #{other}"}}
+      end
+    end
+  end
+
+  @doc "Values must not contain whitespace (MSET splits on runs); use set/3."
+  def mset(_c, pairs) when map_size(pairs) == 0, do: :ok
+
+  def mset(c, pairs) when is_map(pairs) do
+    if Enum.any?(pairs, fn {_k, v} -> String.match?(v, ~r/\s/) end) do
+      {:error, {:bad_argument, "MSET values must not contain whitespace"}}
+    else
+      parts = Enum.flat_map(pairs, fn {k, v} -> [k, v] end)
+
+      case command(c, "MSET #{Enum.join(parts, " ")}") do
+        {:ok, "OK"} -> :ok
+        {:ok, other} -> {:error, {:protocol, "unexpected MSET response: #{other}"}}
+        err -> err
+      end
+    end
+  end
+
+  def exists(c, keys) when is_list(keys) do
+    case command(c, "EXISTS #{Enum.join(keys, " ")}") do
+      {:ok, "EXISTS " <> n} -> {:ok, String.to_integer(n)}
+      {:ok, other} -> {:error, {:protocol, "unexpected EXISTS response: #{other}"}}
+      err -> err
+    end
+  end
+
+  @doc ~S{Sorted keys with the prefix ("" = all).}
+  def scan(c, prefix \\ "") do
+    cmd = if prefix == "", do: "SCAN", else: "SCAN #{prefix}"
+
+    with {:ok, first} <- command(c, cmd) do
+      case first do
+        "KEYS " <> n -> read_lines(c, String.to_integer(n), [])
+        other -> {:error, {:protocol, "unexpected SCAN response: #{other}"}}
+      end
+    end
+  end
+
+  def dbsize(c) do
+    case command(c, "DBSIZE") do
+      {:ok, "DBSIZE " <> n} -> {:ok, String.to_integer(n)}
+      {:ok, other} -> {:error, {:protocol, "unexpected DBSIZE response: #{other}"}}
+      err -> err
+    end
+  end
+
+  @doc "Hex SHA-256 Merkle root of the keyspace (64 zeros when empty)."
+  def merkle_root(c, pattern \\ "") do
+    cmd = if pattern == "", do: "HASH", else: "HASH #{pattern}"
+
+    with {:ok, resp} <- command(c, cmd) do
+      case String.split(resp, " ") do
+        ["HASH" | rest] when rest != [] -> {:ok, List.last(rest)}
+        _ -> {:error, {:protocol, "unexpected HASH response: #{resp}"}}
+      end
+    end
+  end
+
+  def truncate(c) do
+    case command(c, "TRUNCATE") do
+      {:ok, "OK"} -> :ok
+      {:ok, other} -> {:error, {:protocol, "unexpected TRUNCATE response: #{other}"}}
+      err -> err
+    end
+  end
+
+  # -- admin ----------------------------------------------------------------
+
+  def ping(c, msg \\ "") do
+    cmd = if msg == "", do: "PING", else: "PING #{msg}"
+
+    case command(c, cmd) do
+      {:ok, "PONG"} -> {:ok, ""}
+      {:ok, "PONG " <> rest} -> {:ok, rest}
+      {:ok, other} -> {:error, {:protocol, "unexpected PING response: #{other}"}}
+      err -> err
+    end
+  end
+
+  def health_check(c) do
+    match?({:ok, _}, ping(c, "health"))
+  end
+
+  def stats(c) do
+    with {:ok, "STATS"} <- command(c, "STATS") do
+      read_stats_lines(c, %{})
+    else
+      {:ok, other} -> {:error, {:protocol, "unexpected STATS response: #{other}"}}
+      err -> err
+    end
+  end
+
+  def version(c) do
+    case command(c, "VERSION") do
+      {:ok, "VERSION " <> v} -> {:ok, v}
+      {:ok, other} -> {:error, {:protocol, "unexpected VERSION response: #{other}"}}
+      err -> err
+    end
+  end
+
+  # -- pipeline -------------------------------------------------------------
+
+  @doc """
+  Batch single-line-response commands into one write; returns one raw
+  response line per command.
+
+      {:ok, ["OK", "VALUE 1"]} =
+        MerkleKV.pipeline(c, [{:set, "a", "1"}, {:get, "a"}])
+
+  Commands: `{:set, k, v}` | `{:get, k}` | `{:delete, k}`.
+  """
+  def pipeline(_c, []), do: {:ok, []}
+
+  def pipeline(c, commands) when is_list(commands) do
+    lines =
+      Enum.map(commands, fn
+        {:set, k, v} -> "SET #{k} #{v}"
+        {:get, k} -> "GET #{k}"
+        {:delete, k} -> "DEL #{k}"
+      end)
+
+    with :ok <- check_args(lines),
+         :ok <- :gen_tcp.send(c.sock, Enum.map(lines, &[&1, "\r\n"])) do
+      read_lines(c, length(lines), [])
+    end
+  end
+
+  # -- wire -----------------------------------------------------------------
+
+  defp check_args(lines) do
+    if Enum.any?(lines, &String.match?(&1, ~r/[\r\n]/)) do
+      {:error, {:bad_argument, "CR/LF forbidden in arguments"}}
+    else
+      :ok
+    end
+  end
+
+  defp command(c, line) do
+    with :ok <- check_args([line]),
+         :ok <- :gen_tcp.send(c.sock, [line, "\r\n"]),
+         {:ok, resp} <- read_line(c) do
+      case resp do
+        "ERROR " <> msg -> {:error, {:server, msg}}
+        _ -> {:ok, resp}
+      end
+    end
+  end
+
+  # One response line. :gen_tcp in passive raw mode returns whatever bytes
+  # are available; leftover bytes are keyed by socket in the OWNING
+  # process's dictionary (single-process ownership — see moduledoc) so the
+  # struct stays immutable across calls. close/1 reclaims the entry.
+  defp read_line(c) do
+    buf = Process.get({__MODULE__, c.sock}, "")
+
+    case :binary.match(buf, "\n") do
+      {idx, 1} ->
+        <<line::binary-size(idx), _nl, rest::binary>> = buf
+        Process.put({__MODULE__, c.sock}, rest)
+        {:ok, String.trim_trailing(line, "\r")}
+
+      :nomatch ->
+        case :gen_tcp.recv(c.sock, 0, c.timeout) do
+          {:ok, chunk} ->
+            Process.put({__MODULE__, c.sock}, buf <> chunk)
+            read_line(c)
+
+          {:error, :timeout} ->
+            {:error, :timeout}
+
+          {:error, reason} ->
+            {:error, reason}
+        end
+    end
+  end
+
+  defp read_lines(_c, 0, acc), do: {:ok, Enum.reverse(acc)}
+
+  defp read_lines(c, n, acc) do
+    with {:ok, line} <- read_line(c), do: read_lines(c, n - 1, [line | acc])
+  end
+
+  defp read_kv_lines(_c, 0, acc), do: {:ok, acc}
+
+  defp read_kv_lines(c, n, acc) do
+    with {:ok, line} <- read_line(c) do
+      acc =
+        case String.split(line, " ", parts: 2) do
+          [_k, "NOT_FOUND"] -> acc
+          [k, v] -> Map.put(acc, k, v)
+          _ -> acc
+        end
+
+      read_kv_lines(c, n - 1, acc)
+    end
+  end
+
+  defp read_stats_lines(c, acc) do
+    with {:ok, line} <- read_line(c) do
+      case line do
+        "END" ->
+          {:ok, acc}
+
+        _ ->
+          acc =
+            case String.split(line, ":", parts: 2) do
+              [k, v] -> Map.put(acc, k, v)
+              _ -> acc
+            end
+
+          read_stats_lines(c, acc)
+      end
+    end
+  end
+
+  defp int_value(result, verb) do
+    case result do
+      {:ok, "VALUE " <> v} -> {:ok, String.to_integer(v)}
+      {:ok, other} -> {:error, {:protocol, "unexpected #{verb} response: #{other}"}}
+      err -> err
+    end
+  end
+
+  defp str_value(result, verb) do
+    case result do
+      {:ok, "VALUE " <> v} -> {:ok, v}
+      {:ok, other} -> {:error, {:protocol, "unexpected #{verb} response: #{other}"}}
+      err -> err
+    end
+  end
+end

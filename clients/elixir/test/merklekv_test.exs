@@ -1,0 +1,91 @@
+# Self-test against a live server. CI starts one and exports MERKLEKV_PORT;
+# without a reachable server the script exits 0 with a SKIP line. Prints
+# "ELIXIR CLIENT PASS" and exits 0 on success; exits 1 on first failure.
+#
+# Runnable without mix:
+#   elixir -r lib/merklekv.ex test/merklekv_test.exs
+
+defmodule MerkleKVSelfTest do
+  def check(true, what), do: IO.puts("ok - #{what}")
+
+  def check(false, what) do
+    IO.puts(:stderr, "FAIL: #{what}")
+    System.halt(1)
+  end
+
+  def run do
+    case MerkleKV.connect(nil, nil, 10_000) do
+      {:error, reason} ->
+        IO.puts("SKIP: no server reachable: #{inspect(reason)}")
+        System.halt(0)
+
+      {:ok, c} ->
+        run_suite(c)
+        MerkleKV.close(c)
+        IO.puts("ELIXIR CLIENT PASS")
+    end
+  end
+
+  defp run_suite(c) do
+    :ok = MerkleKV.set(c, "ex:k1", "v1")
+    check(MerkleKV.get(c, "ex:k1") == {:ok, "v1"}, "set/get")
+    check(MerkleKV.delete(c, "ex:k1") == {:ok, true}, "delete existing")
+    check(MerkleKV.get(c, "ex:k1") == {:ok, nil}, "get after delete")
+    check(MerkleKV.delete(c, "ex:k1") == {:ok, false}, "delete missing")
+
+    val = "hello world\twith tab"
+    :ok = MerkleKV.set(c, "ex:sp", val)
+    check(MerkleKV.get(c, "ex:sp") == {:ok, val}, "value with space+tab")
+
+    MerkleKV.delete(c, "ex:n")
+    check(MerkleKV.incr(c, "ex:n", 5) == {:ok, 5}, "incr creates")
+    check(MerkleKV.decr(c, "ex:n", 2) == {:ok, 3}, "decr")
+    MerkleKV.delete(c, "ex:s")
+    check(MerkleKV.append(c, "ex:s", "ab") == {:ok, "ab"}, "append creates")
+    check(MerkleKV.prepend(c, "ex:s", "x") == {:ok, "xab"}, "prepend")
+
+    :ok = MerkleKV.mset(c, %{"ex:m1" => "a", "ex:m2" => "b"})
+    check(
+      MerkleKV.mget(c, ["ex:m1", "ex:m2", "ex:nope"]) ==
+        {:ok, %{"ex:m1" => "a", "ex:m2" => "b"}},
+      "mset/mget"
+    )
+    check(MerkleKV.exists(c, ["ex:m1", "ex:m2", "ex:nope"]) == {:ok, 2}, "exists")
+    check(MerkleKV.scan(c, "ex:m") == {:ok, ["ex:m1", "ex:m2"]}, "scan prefix sorted")
+
+    {:ok, h1} = MerkleKV.merkle_root(c)
+    check(String.length(h1) == 64, "merkle root is 64 hex chars")
+    :ok = MerkleKV.set(c, "ex:hk", Integer.to_string(System.monotonic_time()))
+    {:ok, h2} = MerkleKV.merkle_root(c)
+    check(h1 != h2, "root changes after write")
+
+    check(
+      MerkleKV.pipeline(c, [
+        {:set, "ex:p1", "1"},
+        {:set, "ex:p2", "2"},
+        {:get, "ex:p1"},
+        {:delete, "ex:p2"}
+      ]) == {:ok, ["OK", "OK", "VALUE 1", "DELETED"]},
+      "pipeline"
+    )
+
+    check(MerkleKV.health_check(c), "health check")
+    {:ok, stats} = MerkleKV.stats(c)
+    check(Map.has_key?(stats, "total_commands"), "stats has total_commands")
+    {:ok, version} = MerkleKV.version(c)
+    check(String.contains?(version, "."), "version has a dot")
+    {:ok, n} = MerkleKV.dbsize(c)
+    check(n >= 0, "dbsize")
+
+    :ok = MerkleKV.set(c, "ex:notnum", "abc")
+    check(
+      match?(
+        {:error, {:server, msg}} when is_binary(msg),
+        MerkleKV.incr(c, "ex:notnum", 1)
+      ),
+      "INC on non-numeric returns server error"
+    )
+  end
+end
+
+MerkleKVSelfTest.run()

@@ -1,0 +1,284 @@
+/**
+ * Kotlin client for the merklekv_tpu text protocol (docs/PROTOCOL.md; the
+ * same wire surface as the reference MerkleKV, so it works against either
+ * server). Stdlib-only (java.net / java.io); thread-safe — commands
+ * serialize on the instance; [pipeline] batches commands into one write.
+ *
+ *   val c = MerkleKVClient("127.0.0.1", 7379)
+ *   c.set("user:1", "alice")
+ *   c.get("user:1")      // "alice"
+ *   c.incr("visits")     // 1
+ *   c.merkleRoot()       // hex Merkle root
+ *   c.close()
+ */
+
+package io.merklekv.client
+
+import java.io.IOException
+import java.net.InetSocketAddress
+import java.net.Socket
+import java.nio.charset.StandardCharsets
+
+open class MerkleKVException(message: String) : RuntimeException(message)
+
+/** Server answered with an ERROR line. */
+class ServerException(message: String) : MerkleKVException(message)
+
+/** Command round-trip exceeded the configured timeout. */
+class TimeoutException(message: String) : MerkleKVException(message)
+
+class MerkleKVClient(
+    host: String? = null,
+    port: Int? = null,
+    private val timeoutMillis: Int = 5_000,
+) : AutoCloseable {
+    companion object {
+        const val DEFAULT_PORT = 7379
+
+        fun defaultHost(): String = System.getenv("MERKLEKV_HOST") ?: "127.0.0.1"
+
+        fun defaultPort(): Int =
+            System.getenv("MERKLEKV_PORT")?.toIntOrNull() ?: DEFAULT_PORT
+    }
+
+    private val sock = Socket()
+    private val lock = Any()
+    private var buf = ByteArray(0)
+
+    init {
+        val resolvedHost = host ?: defaultHost()
+        val resolvedPort = port ?: defaultPort()
+        sock.tcpNoDelay = true
+        sock.soTimeout = timeoutMillis
+        try {
+            sock.connect(InetSocketAddress(resolvedHost, resolvedPort), timeoutMillis)
+        } catch (e: java.net.SocketTimeoutException) {
+            throw TimeoutException("connect to $resolvedHost:$resolvedPort timed out")
+        }
+    }
+
+    override fun close() {
+        sock.close()
+    }
+
+    // -- basic ops ----------------------------------------------------------
+
+    /** Returns the value, or null when the key is missing. */
+    fun get(key: String): String? {
+        val resp = command("GET $key")
+        if (resp == "NOT_FOUND") return null
+        return expectPrefix(resp, "VALUE ", "GET")
+    }
+
+    fun set(key: String, value: String) {
+        val resp = command("SET $key $value")
+        if (resp != "OK") throw ServerException("unexpected SET response: $resp")
+    }
+
+    /** Returns true when the key existed. */
+    fun delete(key: String): Boolean = command("DEL $key") == "DELETED"
+
+    // -- numeric / string ops -----------------------------------------------
+
+    fun incr(key: String, delta: Long = 1): Long =
+        expectPrefix(command("INC $key $delta"), "VALUE ", "INC").toLong()
+
+    fun decr(key: String, delta: Long = 1): Long =
+        expectPrefix(command("DEC $key $delta"), "VALUE ", "DEC").toLong()
+
+    fun append(key: String, value: String): String =
+        expectPrefix(command("APPEND $key $value"), "VALUE ", "APPEND")
+
+    fun prepend(key: String, value: String): String =
+        expectPrefix(command("PREPEND $key $value"), "VALUE ", "PREPEND")
+
+    // -- bulk / query ops ---------------------------------------------------
+
+    /** Map of found keys only (missing keys omitted). */
+    fun mget(vararg keys: String): Map<String, String> {
+        if (keys.isEmpty()) return emptyMap()
+        synchronized(lock) {
+            writeLine("MGET ${keys.joinToString(" ")}")
+            val first = readLineRaiseError()
+            if (first == "NOT_FOUND") return emptyMap()
+            if (!first.startsWith("VALUES ")) {
+                throw ServerException("unexpected MGET response: $first")
+            }
+            val out = LinkedHashMap<String, String>()
+            repeat(keys.size) {
+                val line = readLine()
+                val sp = line.indexOf(' ')
+                if (sp >= 0) {
+                    val v = line.substring(sp + 1)
+                    if (v != "NOT_FOUND") out[line.substring(0, sp)] = v
+                }
+            }
+            return out
+        }
+    }
+
+    /** Values must not contain whitespace (MSET splits on runs); use [set]. */
+    fun mset(pairs: Map<String, String>) {
+        if (pairs.isEmpty()) return
+        val parts = ArrayList<String>(pairs.size * 2)
+        for ((k, v) in pairs) {
+            require(v.none { it.isWhitespace() }) { "MSET values must not contain whitespace" }
+            parts.add(k)
+            parts.add(v)
+        }
+        val resp = command("MSET ${parts.joinToString(" ")}")
+        if (resp != "OK") throw ServerException("unexpected MSET response: $resp")
+    }
+
+    fun exists(vararg keys: String): Long =
+        expectPrefix(command("EXISTS ${keys.joinToString(" ")}"), "EXISTS ", "EXISTS").toLong()
+
+    /** Sorted keys with the prefix ("" = all). */
+    fun scan(prefix: String = ""): List<String> {
+        val cmd = if (prefix.isEmpty()) "SCAN" else "SCAN $prefix"
+        synchronized(lock) {
+            writeLine(cmd)
+            val first = readLineRaiseError()
+            if (!first.startsWith("KEYS ")) {
+                throw ServerException("unexpected SCAN response: $first")
+            }
+            val n = first.substring(5).toInt()
+            return List(n) { readLine() }
+        }
+    }
+
+    fun dbsize(): Long = expectPrefix(command("DBSIZE"), "DBSIZE ", "DBSIZE").toLong()
+
+    /** Hex SHA-256 Merkle root of the keyspace (64 zeros when empty). */
+    fun merkleRoot(pattern: String = ""): String {
+        val cmd = if (pattern.isEmpty()) "HASH" else "HASH $pattern"
+        val resp = command(cmd)
+        val fields = resp.split(" ")
+        if (fields.firstOrNull() != "HASH" || fields.size < 2) {
+            throw ServerException("unexpected HASH response: $resp")
+        }
+        return fields.last()
+    }
+
+    fun truncate() {
+        val resp = command("TRUNCATE")
+        if (resp != "OK") throw ServerException("unexpected TRUNCATE response: $resp")
+    }
+
+    // -- admin --------------------------------------------------------------
+
+    fun ping(msg: String = ""): String {
+        val resp = command(if (msg.isEmpty()) "PING" else "PING $msg")
+        if (!resp.startsWith("PONG")) throw ServerException("unexpected PING response: $resp")
+        return resp.substring(4).trimStart(' ')
+    }
+
+    fun healthCheck(): Boolean =
+        try {
+            ping("health")
+            true
+        } catch (e: Exception) {
+            when (e) {
+                is MerkleKVException, is IOException -> false
+                else -> throw e
+            }
+        }
+
+    fun stats(): Map<String, String> {
+        synchronized(lock) {
+            writeLine("STATS")
+            val first = readLineRaiseError()
+            if (first != "STATS") throw ServerException("unexpected STATS response: $first")
+            val out = LinkedHashMap<String, String>()
+            while (true) {
+                val line = readLine()
+                if (line == "END") return out
+                val colon = line.indexOf(':')
+                if (colon >= 0) out[line.substring(0, colon)] = line.substring(colon + 1)
+            }
+        }
+    }
+
+    fun version(): String = expectPrefix(command("VERSION"), "VERSION ", "VERSION")
+
+    // -- pipeline -----------------------------------------------------------
+
+    class Pipeline internal constructor() {
+        internal val commands = ArrayList<String>()
+
+        fun set(key: String, value: String) = commands.add("SET $key $value")
+        fun get(key: String) = commands.add("GET $key")
+        fun delete(key: String) = commands.add("DEL $key")
+    }
+
+    /**
+     * Batch single-line-response commands into one write; returns one raw
+     * response line per queued command.
+     *
+     *   val resps = c.pipeline { set("a", "1"); get("a") }
+     */
+    fun pipeline(build: Pipeline.() -> Unit): List<String> {
+        val p = Pipeline()
+        p.build()
+        if (p.commands.isEmpty()) return emptyList()
+        p.commands.forEach { checkArg(it) }
+        synchronized(lock) {
+            val payload = p.commands.joinToString("") { "$it\r\n" }
+            sock.getOutputStream().write(payload.toByteArray(StandardCharsets.UTF_8))
+            return List(p.commands.size) { readLine() }
+        }
+    }
+
+    // -- wire ---------------------------------------------------------------
+
+    private fun checkArg(line: String) {
+        require('\r' !in line && '\n' !in line) { "CR/LF forbidden in arguments" }
+    }
+
+    private fun writeLine(line: String) {
+        checkArg(line)
+        sock.getOutputStream().write("$line\r\n".toByteArray(StandardCharsets.UTF_8))
+    }
+
+    private fun readLine(): String {
+        val deadline = System.nanoTime() + timeoutMillis * 1_000_000L
+        while (true) {
+            val idx = buf.indexOf('\n'.code.toByte())
+            if (idx >= 0) {
+                val end = if (idx > 0 && buf[idx - 1] == '\r'.code.toByte()) idx - 1 else idx
+                val line = String(buf, 0, end, StandardCharsets.UTF_8)
+                buf = buf.copyOfRange(idx + 1, buf.size)
+                return line
+            }
+            if (System.nanoTime() >= deadline) {
+                throw TimeoutException("timed out after ${timeoutMillis}ms")
+            }
+            val chunk = ByteArray(65536)
+            val n = try {
+                sock.getInputStream().read(chunk)
+            } catch (e: java.net.SocketTimeoutException) {
+                throw TimeoutException("timed out after ${timeoutMillis}ms")
+            }
+            if (n < 0) throw MerkleKVException("connection closed")
+            buf += chunk.copyOfRange(0, n)
+        }
+    }
+
+    private fun readLineRaiseError(): String {
+        val resp = readLine()
+        if (resp.startsWith("ERROR ")) throw ServerException(resp.substring(6))
+        return resp
+    }
+
+    private fun command(line: String): String {
+        synchronized(lock) {
+            writeLine(line)
+            return readLineRaiseError()
+        }
+    }
+
+    private fun expectPrefix(resp: String, prefix: String, verb: String): String {
+        if (!resp.startsWith(prefix)) throw ServerException("unexpected $verb response: $resp")
+        return resp.substring(prefix.length)
+    }
+}

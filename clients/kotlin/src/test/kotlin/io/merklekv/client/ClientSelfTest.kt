@@ -1,0 +1,86 @@
+/**
+ * Self-test against a live server. CI starts one and exports MERKLEKV_PORT;
+ * without a reachable server the program exits 0 with a SKIP line. Prints
+ * "KOTLIN CLIENT PASS" and exits 0 on success; exits 1 on first failure.
+ *
+ * Runnable without Gradle:
+ *   kotlinc src/main/kotlin/io/merklekv/client/MerkleKVClient.kt \
+ *           src/test/kotlin/io/merklekv/client/ClientSelfTest.kt \
+ *           -include-runtime -d selftest.jar
+ *   java -jar selftest.jar
+ */
+
+package io.merklekv.client
+
+import kotlin.system.exitProcess
+
+private fun check(cond: Boolean, what: String) {
+    if (!cond) {
+        System.err.println("FAIL: $what")
+        exitProcess(1)
+    }
+    println("ok - $what")
+}
+
+fun main() {
+    val c = try {
+        MerkleKVClient(timeoutMillis = 10_000)
+    } catch (e: Exception) {
+        println("SKIP: no server reachable: ${e.message}")
+        return
+    }
+
+    c.use { client ->
+        client.set("kt:k1", "v1")
+        check(client.get("kt:k1") == "v1", "set/get")
+        check(client.delete("kt:k1"), "delete existing")
+        check(client.get("kt:k1") == null, "get after delete")
+        check(!client.delete("kt:k1"), "delete missing")
+
+        val value = "hello world\twith tab"
+        client.set("kt:sp", value)
+        check(client.get("kt:sp") == value, "value with space+tab")
+
+        client.delete("kt:n")
+        check(client.incr("kt:n", 5) == 5L, "incr creates")
+        check(client.decr("kt:n", 2) == 3L, "decr")
+        client.delete("kt:s")
+        check(client.append("kt:s", "ab") == "ab", "append creates")
+        check(client.prepend("kt:s", "x") == "xab", "prepend")
+
+        client.mset(mapOf("kt:m1" to "a", "kt:m2" to "b"))
+        val got = client.mget("kt:m1", "kt:m2", "kt:nope")
+        check(got == mapOf("kt:m1" to "a", "kt:m2" to "b"), "mset/mget")
+        check(client.exists("kt:m1", "kt:m2", "kt:nope") == 2L, "exists")
+        check(client.scan("kt:m") == listOf("kt:m1", "kt:m2"), "scan prefix sorted")
+
+        val h1 = client.merkleRoot()
+        check(h1.length == 64, "merkle root is 64 hex chars")
+        client.set("kt:hk", System.nanoTime().toString())
+        check(client.merkleRoot() != h1, "root changes after write")
+
+        val resps = client.pipeline {
+            set("kt:p1", "1")
+            set("kt:p2", "2")
+            get("kt:p1")
+            delete("kt:p2")
+        }
+        check(resps == listOf("OK", "OK", "VALUE 1", "DELETED"), "pipeline")
+
+        check(client.healthCheck(), "health check")
+        check("total_commands" in client.stats(), "stats has total_commands")
+        check("." in client.version(), "version has a dot")
+        check(client.dbsize() >= 0, "dbsize")
+
+        client.set("kt:notnum", "abc")
+        val threw = try {
+            client.incr("kt:notnum", 1)
+            false
+        } catch (e: ServerException) {
+            "not a valid number" in (e.message ?: "")
+        }
+        check(threw, "INC on non-numeric raises ServerException")
+    }
+
+    println("KOTLIN CLIENT PASS")
+}

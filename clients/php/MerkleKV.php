@@ -1,0 +1,385 @@
+<?php
+/**
+ * PHP client for the merklekv_tpu text protocol (docs/PROTOCOL.md; the same
+ * wire surface as the reference MerkleKV, so it works against either
+ * server). Stdlib-only (ext/sockets not required — plain stream sockets);
+ * one connection per client, commands serialize on the instance.
+ *
+ *   $c = new MerkleKV\Client("127.0.0.1", 7379);
+ *   $c->set("user:1", "alice");
+ *   $c->get("user:1");      // "alice"
+ *   $c->incr("visits");     // 1
+ *   $c->merkleRoot();       // hex Merkle root
+ *   $c->close();
+ */
+
+namespace MerkleKV;
+
+class Error extends \RuntimeException {}
+/** Server answered with an ERROR line. */
+class ServerError extends Error {}
+/** Command round-trip exceeded the configured timeout. */
+class TimeoutError extends Error {}
+
+class Client
+{
+    public const DEFAULT_PORT = 7379;
+
+    /** @var resource|null */
+    private $sock;
+    private string $buf = "";
+    private float $timeout;
+
+    public static function defaultHost(): string
+    {
+        return getenv("MERKLEKV_HOST") ?: "127.0.0.1";
+    }
+
+    public static function defaultPort(): int
+    {
+        $p = getenv("MERKLEKV_PORT");
+        return $p === false ? self::DEFAULT_PORT : (int) $p;
+    }
+
+    public function __construct(?string $host = null, ?int $port = null, float $timeout = 5.0)
+    {
+        $host = $host ?? self::defaultHost();
+        $port = $port ?? self::defaultPort();
+        $this->timeout = $timeout;
+        $sock = @stream_socket_client(
+            "tcp://{$host}:{$port}", $errno, $errstr, $timeout
+        );
+        if ($sock === false) {
+            throw new Error("connect to {$host}:{$port} failed: {$errstr}");
+        }
+        stream_set_blocking($sock, true);
+        // Per-read timeout; the deadline loop in readLine() enforces the
+        // overall budget.
+        stream_set_timeout($sock, (int) $timeout, (int) (fmod($timeout, 1.0) * 1e6));
+        if (function_exists("socket_import_stream")) {
+            $raw = socket_import_stream($sock);
+            if ($raw !== false) {
+                @socket_set_option($raw, SOL_TCP, TCP_NODELAY, 1);
+            }
+        }
+        $this->sock = $sock;
+    }
+
+    public function close(): void
+    {
+        if ($this->sock !== null) {
+            fclose($this->sock);
+            $this->sock = null;
+        }
+    }
+
+    // -- basic ops ----------------------------------------------------------
+
+    /** Returns the value, or null when the key is missing. */
+    public function get(string $key): ?string
+    {
+        $resp = $this->command("GET {$key}");
+        if ($resp === "NOT_FOUND") {
+            return null;
+        }
+        return $this->expectPrefix($resp, "VALUE ", "GET");
+    }
+
+    public function set(string $key, string $value): void
+    {
+        $resp = $this->command("SET {$key} {$value}");
+        if ($resp !== "OK") {
+            throw new ServerError("unexpected SET response: {$resp}");
+        }
+    }
+
+    /** Returns true when the key existed. */
+    public function delete(string $key): bool
+    {
+        return $this->command("DEL {$key}") === "DELETED";
+    }
+
+    // -- numeric / string ops -----------------------------------------------
+
+    public function incr(string $key, int $delta = 1): int
+    {
+        return (int) $this->expectPrefix($this->command("INC {$key} {$delta}"), "VALUE ", "INC");
+    }
+
+    public function decr(string $key, int $delta = 1): int
+    {
+        return (int) $this->expectPrefix($this->command("DEC {$key} {$delta}"), "VALUE ", "DEC");
+    }
+
+    public function append(string $key, string $value): string
+    {
+        return $this->expectPrefix($this->command("APPEND {$key} {$value}"), "VALUE ", "APPEND");
+    }
+
+    public function prepend(string $key, string $value): string
+    {
+        return $this->expectPrefix($this->command("PREPEND {$key} {$value}"), "VALUE ", "PREPEND");
+    }
+
+    // -- bulk / query ops ---------------------------------------------------
+
+    /** Map of found keys only (missing keys omitted). @return array<string,string> */
+    public function mget(string ...$keys): array
+    {
+        if (count($keys) === 0) {
+            return [];
+        }
+        $first = $this->command("MGET " . implode(" ", $keys));
+        $out = [];
+        if ($first === "NOT_FOUND") {
+            return $out;
+        }
+        if (strncmp($first, "VALUES ", 7) !== 0) {
+            throw new ServerError("unexpected MGET response: {$first}");
+        }
+        foreach ($keys as $_) {
+            $line = $this->readLine();
+            $sp = strpos($line, " ");
+            if ($sp === false) {
+                continue;
+            }
+            $k = substr($line, 0, $sp);
+            $v = substr($line, $sp + 1);
+            if ($v !== "NOT_FOUND") {
+                $out[$k] = $v;
+            }
+        }
+        return $out;
+    }
+
+    /**
+     * Values must not contain whitespace (MSET splits on runs); use set().
+     * @param array<string,string> $pairs
+     */
+    public function mset(array $pairs): void
+    {
+        if (count($pairs) === 0) {
+            return;
+        }
+        $parts = [];
+        foreach ($pairs as $k => $v) {
+            if (preg_match('/\s/', $v)) {
+                throw new \InvalidArgumentException("MSET values must not contain whitespace");
+            }
+            $parts[] = $k;
+            $parts[] = $v;
+        }
+        $resp = $this->command("MSET " . implode(" ", $parts));
+        if ($resp !== "OK") {
+            throw new ServerError("unexpected MSET response: {$resp}");
+        }
+    }
+
+    public function exists(string ...$keys): int
+    {
+        return (int) $this->expectPrefix(
+            $this->command("EXISTS " . implode(" ", $keys)), "EXISTS ", "EXISTS"
+        );
+    }
+
+    /** Sorted keys with the prefix ("" = all). @return list<string> */
+    public function scan(string $prefix = ""): array
+    {
+        $cmd = $prefix === "" ? "SCAN" : "SCAN {$prefix}";
+        $first = $this->command($cmd);
+        if (strncmp($first, "KEYS ", 5) !== 0) {
+            throw new ServerError("unexpected SCAN response: {$first}");
+        }
+        $n = (int) substr($first, 5);
+        $out = [];
+        for ($i = 0; $i < $n; $i++) {
+            $out[] = $this->readLine();
+        }
+        return $out;
+    }
+
+    public function dbsize(): int
+    {
+        return (int) $this->expectPrefix($this->command("DBSIZE"), "DBSIZE ", "DBSIZE");
+    }
+
+    /** Hex SHA-256 Merkle root of the keyspace (64 zeros when empty). */
+    public function merkleRoot(string $pattern = ""): string
+    {
+        $cmd = $pattern === "" ? "HASH" : "HASH {$pattern}";
+        $resp = $this->command($cmd);
+        $fields = explode(" ", $resp);
+        if ($fields[0] !== "HASH" || count($fields) < 2) {
+            throw new ServerError("unexpected HASH response: {$resp}");
+        }
+        return end($fields);
+    }
+
+    public function truncate(): void
+    {
+        $resp = $this->command("TRUNCATE");
+        if ($resp !== "OK") {
+            throw new ServerError("unexpected TRUNCATE response: {$resp}");
+        }
+    }
+
+    // -- admin --------------------------------------------------------------
+
+    public function ping(string $msg = ""): string
+    {
+        $resp = $this->command($msg === "" ? "PING" : "PING {$msg}");
+        if (strncmp($resp, "PONG", 4) !== 0) {
+            throw new ServerError("unexpected PING response: {$resp}");
+        }
+        return ltrim(substr($resp, 4), " ");
+    }
+
+    public function healthCheck(): bool
+    {
+        try {
+            $this->ping("health");
+            return true;
+        } catch (Error $e) {
+            return false;
+        }
+    }
+
+    /** @return array<string,string> */
+    public function stats(): array
+    {
+        $first = $this->command("STATS");
+        if ($first !== "STATS") {
+            throw new ServerError("unexpected STATS response: {$first}");
+        }
+        $out = [];
+        while (true) {
+            $line = $this->readLine();
+            if ($line === "END") {
+                return $out;
+            }
+            $colon = strpos($line, ":");
+            if ($colon !== false) {
+                $out[substr($line, 0, $colon)] = substr($line, $colon + 1);
+            }
+        }
+    }
+
+    public function version(): string
+    {
+        return $this->expectPrefix($this->command("VERSION"), "VERSION ", "VERSION");
+    }
+
+    // -- pipeline -----------------------------------------------------------
+
+    /**
+     * Batch single-line-response commands into one write. $fn receives a
+     * Pipeline; returns one raw response line per queued command.
+     *
+     *   $resps = $c->pipeline(function ($p) { $p->set("a", "1"); $p->get("a"); });
+     *
+     * @return list<string>
+     */
+    public function pipeline(callable $fn): array
+    {
+        $p = new Pipeline();
+        $fn($p);
+        $cmds = $p->commands;
+        if (count($cmds) === 0) {
+            return [];
+        }
+        $payload = "";
+        foreach ($cmds as $c) {
+            $this->checkArg($c);
+            $payload .= $c . "\r\n";
+        }
+        $this->writeAll($payload);
+        $out = [];
+        foreach ($cmds as $_) {
+            $out[] = $this->readLine();
+        }
+        return $out;
+    }
+
+    // -- wire ---------------------------------------------------------------
+
+    private function checkArg(string $line): void
+    {
+        if (strpbrk($line, "\r\n") !== false) {
+            throw new \InvalidArgumentException("CR/LF forbidden in arguments");
+        }
+    }
+
+    private function writeAll(string $payload): void
+    {
+        if ($this->sock === null) {
+            throw new Error("client is closed");
+        }
+        $off = 0;
+        $len = strlen($payload);
+        while ($off < $len) {
+            $n = fwrite($this->sock, substr($payload, $off));
+            if ($n === false || $n === 0) {
+                throw new Error("connection closed during write");
+            }
+            $off += $n;
+        }
+    }
+
+    private function readLine(): string
+    {
+        $deadline = microtime(true) + $this->timeout;
+        while (($idx = strpos($this->buf, "\n")) === false) {
+            if (microtime(true) >= $deadline) {
+                throw new TimeoutError("timed out after {$this->timeout}s");
+            }
+            $chunk = fread($this->sock, 65536);
+            if ($chunk === false || ($chunk === "" && feof($this->sock))) {
+                throw new Error("connection closed");
+            }
+            $this->buf .= $chunk;
+        }
+        $line = substr($this->buf, 0, $idx);
+        $this->buf = substr($this->buf, $idx + 1);
+        return rtrim($line, "\r");
+    }
+
+    private function command(string $line): string
+    {
+        $this->checkArg($line);
+        $this->writeAll($line . "\r\n");
+        $resp = $this->readLine();
+        if (strncmp($resp, "ERROR ", 6) === 0) {
+            throw new ServerError(substr($resp, 6));
+        }
+        return $resp;
+    }
+
+    private function expectPrefix(string $resp, string $prefix, string $verb): string
+    {
+        if (strncmp($resp, $prefix, strlen($prefix)) !== 0) {
+            throw new ServerError("unexpected {$verb} response: {$resp}");
+        }
+        return substr($resp, strlen($prefix));
+    }
+}
+
+class Pipeline
+{
+    /** @var list<string> */
+    public array $commands = [];
+
+    public function set(string $key, string $value): void
+    {
+        $this->commands[] = "SET {$key} {$value}";
+    }
+
+    public function get(string $key): void
+    {
+        $this->commands[] = "GET {$key}";
+    }
+
+    public function delete(string $key): void
+    {
+        $this->commands[] = "DEL {$key}";
+    }
+}

@@ -1,0 +1,88 @@
+<?php
+/**
+ * Self-test against a live server. CI starts one and exports MERKLEKV_PORT;
+ * without a reachable server the script exits 0 with a SKIP line. Prints
+ * "PHP CLIENT PASS" and exits 0 on success; exits 1 on the first failure.
+ */
+
+require __DIR__ . "/MerkleKV.php";
+
+use MerkleKV\Client;
+use MerkleKV\ServerError;
+
+function check(bool $cond, string $what): void
+{
+    if (!$cond) {
+        fwrite(STDERR, "FAIL: {$what}\n");
+        exit(1);
+    }
+    echo "ok - {$what}\n";
+}
+
+try {
+    $c = new Client(null, null, 10.0);
+} catch (\Throwable $e) {
+    echo "SKIP: no server reachable: {$e->getMessage()}\n";
+    exit(0);
+}
+
+// set / get / delete
+$c->set("php:k1", "v1");
+check($c->get("php:k1") === "v1", "set/get");
+check($c->delete("php:k1") === true, "delete existing");
+check($c->get("php:k1") === null, "get after delete");
+check($c->delete("php:k1") === false, "delete missing");
+
+// values with spaces and tabs
+$val = "hello world\twith tab";
+$c->set("php:sp", $val);
+check($c->get("php:sp") === $val, "value with space+tab");
+
+// numeric / splice
+$c->delete("php:n");
+check($c->incr("php:n", 5) === 5, "incr creates");
+check($c->decr("php:n", 2) === 3, "decr");
+$c->delete("php:s");
+check($c->append("php:s", "ab") === "ab", "append creates");
+check($c->prepend("php:s", "x") === "xab", "prepend");
+
+// mget / mset / scan / exists
+$c->mset(["php:m1" => "a", "php:m2" => "b"]);
+$got = $c->mget("php:m1", "php:m2", "php:nope");
+check($got === ["php:m1" => "a", "php:m2" => "b"], "mset/mget");
+check($c->exists("php:m1", "php:m2", "php:nope") === 2, "exists");
+check($c->scan("php:m") === ["php:m1", "php:m2"], "scan prefix sorted");
+
+// hash changes with writes
+$h1 = $c->merkleRoot();
+check(strlen($h1) === 64, "merkle root is 64 hex chars");
+$c->set("php:hk", (string) microtime(true));
+check($c->merkleRoot() !== $h1, "root changes after write");
+
+// pipeline
+$resps = $c->pipeline(function ($p) {
+    $p->set("php:p1", "1");
+    $p->set("php:p2", "2");
+    $p->get("php:p1");
+    $p->delete("php:p2");
+});
+check($resps === ["OK", "OK", "VALUE 1", "DELETED"], "pipeline");
+
+// stats / health / version / dbsize
+check($c->healthCheck() === true, "health check");
+check(array_key_exists("total_commands", $c->stats()), "stats has total_commands");
+check(strpos($c->version(), ".") !== false, "version has a dot");
+check($c->dbsize() >= 0, "dbsize");
+
+// server error surfaces as ServerError
+$c->set("php:notnum", "abc");
+$threw = false;
+try {
+    $c->incr("php:notnum", 1);
+} catch (ServerError $e) {
+    $threw = strpos($e->getMessage(), "not a valid number") !== false;
+}
+check($threw, "INC on non-numeric raises ServerError");
+
+$c->close();
+echo "PHP CLIENT PASS\n";

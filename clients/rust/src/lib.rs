@@ -1,0 +1,378 @@
+//! Rust client for the merklekv_tpu text protocol (docs/PROTOCOL.md; the
+//! same wire surface as the reference MerkleKV, so it works against either
+//! server). Std-only — no external crates.
+//!
+//! ```no_run
+//! use merklekv_client::Client;
+//! let mut c = Client::connect("127.0.0.1", 7379).unwrap();
+//! c.set("user:1", "alice").unwrap();
+//! assert_eq!(c.get("user:1").unwrap(), Some("alice".to_string()));
+//! let root = c.merkle_root().unwrap(); // hex SHA-256 Merkle root
+//! ```
+
+use std::collections::HashMap;
+use std::fmt;
+use std::io::{Read, Write};
+use std::net::{TcpStream, ToSocketAddrs};
+use std::time::{Duration, Instant};
+
+pub const DEFAULT_PORT: u16 = 7379;
+
+#[derive(Debug)]
+pub enum Error {
+    /// Transport-level failure (connect, read, write, close).
+    Io(std::io::Error),
+    /// Server answered with an `ERROR` line.
+    Server(String),
+    /// Command round-trip exceeded the configured timeout.
+    Timeout,
+    /// Caller passed an argument the protocol cannot frame (CR/LF, ...).
+    BadArgument(String),
+    /// Server answered something outside the protocol for this verb.
+    Protocol(String),
+}
+
+impl fmt::Display for Error {
+    fn fmt(&self, f: &mut fmt::Formatter<'_>) -> fmt::Result {
+        match self {
+            Error::Io(e) => write!(f, "io error: {e}"),
+            Error::Server(m) => write!(f, "server error: {m}"),
+            Error::Timeout => write!(f, "timed out"),
+            Error::BadArgument(m) => write!(f, "bad argument: {m}"),
+            Error::Protocol(m) => write!(f, "protocol error: {m}"),
+        }
+    }
+}
+
+impl std::error::Error for Error {}
+
+impl From<std::io::Error> for Error {
+    fn from(e: std::io::Error) -> Self {
+        if e.kind() == std::io::ErrorKind::WouldBlock
+            || e.kind() == std::io::ErrorKind::TimedOut
+        {
+            Error::Timeout
+        } else {
+            Error::Io(e)
+        }
+    }
+}
+
+pub type Result<T> = std::result::Result<T, Error>;
+
+/// One TCP connection speaking the line protocol. Not `Sync` — share via a
+/// pool or a mutex at the application layer, like the reference clients.
+pub struct Client {
+    sock: TcpStream,
+    buf: Vec<u8>,
+    timeout: Duration,
+}
+
+impl Client {
+    /// Connect to `MERKLEKV_HOST` / `MERKLEKV_PORT` (default
+    /// 127.0.0.1:7379) with a 5 s timeout.
+    pub fn connect_default() -> Result<Self> {
+        let host = std::env::var("MERKLEKV_HOST").unwrap_or_else(|_| "127.0.0.1".into());
+        let port = std::env::var("MERKLEKV_PORT")
+            .ok()
+            .and_then(|p| p.parse().ok())
+            .unwrap_or(DEFAULT_PORT);
+        Self::connect(&host, port)
+    }
+
+    pub fn connect(host: &str, port: u16) -> Result<Self> {
+        Self::connect_timeout(host, port, Duration::from_secs(5))
+    }
+
+    pub fn connect_timeout(host: &str, port: u16, timeout: Duration) -> Result<Self> {
+        let addr = (host, port)
+            .to_socket_addrs()?
+            .next()
+            .ok_or_else(|| Error::BadArgument(format!("unresolvable host: {host}")))?;
+        let sock = TcpStream::connect_timeout(&addr, timeout)?;
+        sock.set_nodelay(true)?;
+        sock.set_read_timeout(Some(timeout))?;
+        sock.set_write_timeout(Some(timeout))?;
+        Ok(Client { sock, buf: Vec::new(), timeout })
+    }
+
+    // -- basic ops ----------------------------------------------------------
+
+    /// `Ok(None)` when the key is missing.
+    pub fn get(&mut self, key: &str) -> Result<Option<String>> {
+        let resp = self.command(&format!("GET {key}"))?;
+        if resp == "NOT_FOUND" {
+            return Ok(None);
+        }
+        Ok(Some(expect_prefix(&resp, "VALUE ", "GET")?.to_string()))
+    }
+
+    pub fn set(&mut self, key: &str, value: &str) -> Result<()> {
+        let resp = self.command(&format!("SET {key} {value}"))?;
+        if resp != "OK" {
+            return Err(Error::Protocol(format!("unexpected SET response: {resp}")));
+        }
+        Ok(())
+    }
+
+    /// `Ok(true)` when the key existed.
+    pub fn delete(&mut self, key: &str) -> Result<bool> {
+        Ok(self.command(&format!("DEL {key}"))? == "DELETED")
+    }
+
+    // -- numeric / string ops -----------------------------------------------
+
+    pub fn incr(&mut self, key: &str, delta: i64) -> Result<i64> {
+        parse_int(expect_prefix(&self.command(&format!("INC {key} {delta}"))?, "VALUE ", "INC")?)
+    }
+
+    pub fn decr(&mut self, key: &str, delta: i64) -> Result<i64> {
+        parse_int(expect_prefix(&self.command(&format!("DEC {key} {delta}"))?, "VALUE ", "DEC")?)
+    }
+
+    pub fn append(&mut self, key: &str, value: &str) -> Result<String> {
+        Ok(expect_prefix(&self.command(&format!("APPEND {key} {value}"))?, "VALUE ", "APPEND")?
+            .to_string())
+    }
+
+    pub fn prepend(&mut self, key: &str, value: &str) -> Result<String> {
+        Ok(expect_prefix(&self.command(&format!("PREPEND {key} {value}"))?, "VALUE ", "PREPEND")?
+            .to_string())
+    }
+
+    // -- bulk / query ops ---------------------------------------------------
+
+    /// Map of found keys only (missing keys omitted).
+    pub fn mget(&mut self, keys: &[&str]) -> Result<HashMap<String, String>> {
+        let mut out = HashMap::new();
+        if keys.is_empty() {
+            return Ok(out);
+        }
+        let first = self.command(&format!("MGET {}", keys.join(" ")))?;
+        if first == "NOT_FOUND" {
+            return Ok(out);
+        }
+        if !first.starts_with("VALUES ") {
+            return Err(Error::Protocol(format!("unexpected MGET response: {first}")));
+        }
+        for _ in keys {
+            let line = self.read_line()?;
+            if let Some((k, v)) = line.split_once(' ') {
+                if v != "NOT_FOUND" {
+                    out.insert(k.to_string(), v.to_string());
+                }
+            }
+        }
+        Ok(out)
+    }
+
+    /// Values must not contain whitespace (MSET splits on runs); use `set`.
+    pub fn mset(&mut self, pairs: &[(&str, &str)]) -> Result<()> {
+        if pairs.is_empty() {
+            return Ok(());
+        }
+        let mut parts = Vec::with_capacity(pairs.len() * 2);
+        for (k, v) in pairs {
+            if v.chars().any(char::is_whitespace) {
+                return Err(Error::BadArgument(
+                    "MSET values must not contain whitespace".into(),
+                ));
+            }
+            parts.push(*k);
+            parts.push(*v);
+        }
+        let resp = self.command(&format!("MSET {}", parts.join(" ")))?;
+        if resp != "OK" {
+            return Err(Error::Protocol(format!("unexpected MSET response: {resp}")));
+        }
+        Ok(())
+    }
+
+    pub fn exists(&mut self, keys: &[&str]) -> Result<u64> {
+        let resp = self.command(&format!("EXISTS {}", keys.join(" ")))?;
+        expect_prefix(&resp, "EXISTS ", "EXISTS")?
+            .parse()
+            .map_err(|_| Error::Protocol(format!("non-numeric EXISTS count: {resp}")))
+    }
+
+    /// Sorted keys with the prefix (`""` = all).
+    pub fn scan(&mut self, prefix: &str) -> Result<Vec<String>> {
+        let cmd = if prefix.is_empty() { "SCAN".to_string() } else { format!("SCAN {prefix}") };
+        let first = self.command(&cmd)?;
+        let n: usize = expect_prefix(&first, "KEYS ", "SCAN")?
+            .parse()
+            .map_err(|_| Error::Protocol(format!("non-numeric SCAN count: {first}")))?;
+        let mut out = Vec::with_capacity(n);
+        for _ in 0..n {
+            out.push(self.read_line()?);
+        }
+        Ok(out)
+    }
+
+    pub fn dbsize(&mut self) -> Result<u64> {
+        let resp = self.command("DBSIZE")?;
+        expect_prefix(&resp, "DBSIZE ", "DBSIZE")?
+            .parse()
+            .map_err(|_| Error::Protocol(format!("non-numeric DBSIZE: {resp}")))
+    }
+
+    /// Hex SHA-256 Merkle root of the keyspace (64 zeros when empty).
+    pub fn merkle_root(&mut self) -> Result<String> {
+        self.merkle_root_pattern("")
+    }
+
+    pub fn merkle_root_pattern(&mut self, pattern: &str) -> Result<String> {
+        let cmd = if pattern.is_empty() { "HASH".to_string() } else { format!("HASH {pattern}") };
+        let resp = self.command(&cmd)?;
+        let fields: Vec<&str> = resp.split(' ').collect();
+        if fields.first() != Some(&"HASH") || fields.len() < 2 {
+            return Err(Error::Protocol(format!("unexpected HASH response: {resp}")));
+        }
+        Ok(fields.last().unwrap().to_string())
+    }
+
+    pub fn truncate(&mut self) -> Result<()> {
+        let resp = self.command("TRUNCATE")?;
+        if resp != "OK" {
+            return Err(Error::Protocol(format!("unexpected TRUNCATE response: {resp}")));
+        }
+        Ok(())
+    }
+
+    // -- admin --------------------------------------------------------------
+
+    pub fn ping(&mut self, msg: &str) -> Result<String> {
+        let cmd = if msg.is_empty() { "PING".to_string() } else { format!("PING {msg}") };
+        let resp = self.command(&cmd)?;
+        if !resp.starts_with("PONG") {
+            return Err(Error::Protocol(format!("unexpected PING response: {resp}")));
+        }
+        Ok(resp[4..].trim_start_matches(' ').to_string())
+    }
+
+    pub fn health_check(&mut self) -> bool {
+        self.ping("health").is_ok()
+    }
+
+    pub fn stats(&mut self) -> Result<HashMap<String, String>> {
+        let first = self.command("STATS")?;
+        if first != "STATS" {
+            return Err(Error::Protocol(format!("unexpected STATS response: {first}")));
+        }
+        let mut out = HashMap::new();
+        loop {
+            let line = self.read_line()?;
+            if line == "END" {
+                return Ok(out);
+            }
+            if let Some((k, v)) = line.split_once(':') {
+                out.insert(k.to_string(), v.to_string());
+            }
+        }
+    }
+
+    pub fn version(&mut self) -> Result<String> {
+        Ok(expect_prefix(&self.command("VERSION")?, "VERSION ", "VERSION")?.to_string())
+    }
+
+    // -- pipeline -----------------------------------------------------------
+
+    /// Batch single-line-response commands into one write; returns one raw
+    /// response line per queued command.
+    pub fn pipeline(&mut self, build: impl FnOnce(&mut Pipeline)) -> Result<Vec<String>> {
+        let mut p = Pipeline::default();
+        build(&mut p);
+        if p.commands.is_empty() {
+            return Ok(Vec::new());
+        }
+        let mut payload = String::new();
+        for c in &p.commands {
+            check_arg(c)?;
+            payload.push_str(c);
+            payload.push_str("\r\n");
+        }
+        self.sock.write_all(payload.as_bytes())?;
+        let mut out = Vec::with_capacity(p.commands.len());
+        for _ in &p.commands {
+            out.push(self.read_line()?);
+        }
+        Ok(out)
+    }
+
+    // -- wire ---------------------------------------------------------------
+
+    fn command(&mut self, line: &str) -> Result<String> {
+        check_arg(line)?;
+        self.sock.write_all(line.as_bytes())?;
+        self.sock.write_all(b"\r\n")?;
+        let resp = self.read_line()?;
+        if let Some(msg) = resp.strip_prefix("ERROR ") {
+            return Err(Error::Server(msg.to_string()));
+        }
+        Ok(resp)
+    }
+
+    fn read_line(&mut self) -> Result<String> {
+        let deadline = Instant::now() + self.timeout;
+        loop {
+            if let Some(idx) = self.buf.iter().position(|&b| b == b'\n') {
+                let mut line: Vec<u8> = self.buf.drain(..=idx).collect();
+                line.pop(); // \n
+                if line.last() == Some(&b'\r') {
+                    line.pop();
+                }
+                return String::from_utf8(line)
+                    .map_err(|e| Error::Protocol(format!("non-UTF-8 response: {e}")));
+            }
+            if Instant::now() >= deadline {
+                return Err(Error::Timeout);
+            }
+            let mut chunk = [0u8; 65536];
+            let n = self.sock.read(&mut chunk)?;
+            if n == 0 {
+                return Err(Error::Io(std::io::Error::new(
+                    std::io::ErrorKind::UnexpectedEof,
+                    "connection closed",
+                )));
+            }
+            self.buf.extend_from_slice(&chunk[..n]);
+        }
+    }
+}
+
+/// Command batch for [`Client::pipeline`].
+#[derive(Default)]
+pub struct Pipeline {
+    commands: Vec<String>,
+}
+
+impl Pipeline {
+    pub fn set(&mut self, key: &str, value: &str) {
+        self.commands.push(format!("SET {key} {value}"));
+    }
+
+    pub fn get(&mut self, key: &str) {
+        self.commands.push(format!("GET {key}"));
+    }
+
+    pub fn delete(&mut self, key: &str) {
+        self.commands.push(format!("DEL {key}"));
+    }
+}
+
+fn check_arg(line: &str) -> Result<()> {
+    if line.contains('\r') || line.contains('\n') {
+        return Err(Error::BadArgument("CR/LF forbidden in arguments".into()));
+    }
+    Ok(())
+}
+
+fn expect_prefix<'a>(resp: &'a str, prefix: &str, verb: &str) -> Result<&'a str> {
+    resp.strip_prefix(prefix)
+        .ok_or_else(|| Error::Protocol(format!("unexpected {verb} response: {resp}")))
+}
+
+fn parse_int(s: &str) -> Result<i64> {
+    s.parse()
+        .map_err(|_| Error::Protocol(format!("non-numeric VALUE: {s}")))
+}

@@ -1,0 +1,98 @@
+//! Integration tests against a live server. CI starts one and exports
+//! MERKLEKV_PORT; without a reachable server every test is a no-op pass
+//! (prints a skip note), matching the other SDK suites.
+
+use merklekv_client::{Client, Error};
+
+fn connect() -> Option<Client> {
+    match Client::connect_default() {
+        Ok(c) => Some(c),
+        Err(e) => {
+            eprintln!("SKIP: no server reachable: {e}");
+            None
+        }
+    }
+}
+
+#[test]
+fn set_get_delete() {
+    let Some(mut c) = connect() else { return };
+    c.set("rs:k1", "v1").unwrap();
+    assert_eq!(c.get("rs:k1").unwrap(), Some("v1".into()));
+    assert!(c.delete("rs:k1").unwrap());
+    assert_eq!(c.get("rs:k1").unwrap(), None);
+    assert!(!c.delete("rs:k1").unwrap());
+}
+
+#[test]
+fn values_with_spaces_and_tabs() {
+    let Some(mut c) = connect() else { return };
+    let val = "hello world\twith tab";
+    c.set("rs:sp", val).unwrap();
+    assert_eq!(c.get("rs:sp").unwrap(), Some(val.into()));
+}
+
+#[test]
+fn numeric_and_splice() {
+    let Some(mut c) = connect() else { return };
+    c.delete("rs:n").unwrap();
+    assert_eq!(c.incr("rs:n", 5).unwrap(), 5);
+    assert_eq!(c.decr("rs:n", 2).unwrap(), 3);
+    c.delete("rs:s").unwrap();
+    assert_eq!(c.append("rs:s", "ab").unwrap(), "ab");
+    assert_eq!(c.prepend("rs:s", "x").unwrap(), "xab");
+}
+
+#[test]
+fn mget_mset_scan_exists() {
+    let Some(mut c) = connect() else { return };
+    c.mset(&[("rs:m1", "a"), ("rs:m2", "b")]).unwrap();
+    let got = c.mget(&["rs:m1", "rs:m2", "rs:nope"]).unwrap();
+    assert_eq!(got.len(), 2);
+    assert_eq!(got["rs:m1"], "a");
+    assert_eq!(got["rs:m2"], "b");
+    assert_eq!(c.exists(&["rs:m1", "rs:m2", "rs:nope"]).unwrap(), 2);
+    assert_eq!(c.scan("rs:m").unwrap(), vec!["rs:m1", "rs:m2"]);
+}
+
+#[test]
+fn hash_changes_with_writes() {
+    let Some(mut c) = connect() else { return };
+    let h1 = c.merkle_root().unwrap();
+    assert_eq!(h1.len(), 64);
+    c.set("rs:hk", &format!("{:?}", std::time::Instant::now())).unwrap();
+    assert_ne!(c.merkle_root().unwrap(), h1);
+}
+
+#[test]
+fn pipeline() {
+    let Some(mut c) = connect() else { return };
+    let resps = c
+        .pipeline(|p| {
+            p.set("rs:p1", "1");
+            p.set("rs:p2", "2");
+            p.get("rs:p1");
+            p.delete("rs:p2");
+        })
+        .unwrap();
+    assert_eq!(resps, vec!["OK", "OK", "VALUE 1", "DELETED"]);
+}
+
+#[test]
+fn stats_health_version() {
+    let Some(mut c) = connect() else { return };
+    assert!(c.health_check());
+    assert!(c.stats().unwrap().contains_key("total_commands"));
+    assert!(c.version().unwrap().contains('.'));
+    let _ = c.dbsize().unwrap();
+}
+
+#[test]
+fn server_error_surfaces() {
+    let Some(mut c) = connect() else { return };
+    c.set("rs:notnum", "abc").unwrap();
+    match c.incr("rs:notnum", 1) {
+        Err(Error::Server(msg)) => assert!(msg.contains("not a valid number")),
+        other => panic!("expected Server error, got {other:?}"),
+    }
+}

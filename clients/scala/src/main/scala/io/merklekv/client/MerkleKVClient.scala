@@ -1,0 +1,272 @@
+/**
+ * Scala client for the merklekv_tpu text protocol (docs/PROTOCOL.md; the
+ * same wire surface as the reference MerkleKV, so it works against either
+ * server). Stdlib-only (java.net / java.io); thread-safe — commands
+ * serialize on the instance; `pipeline` batches commands into one write.
+ *
+ *   val c = new MerkleKVClient("127.0.0.1", 7379)
+ *   c.set("user:1", "alice")
+ *   c.get("user:1")      // Some("alice")
+ *   c.incr("visits")     // 1
+ *   c.merkleRoot()       // hex Merkle root
+ *   c.close()
+ */
+
+package io.merklekv.client
+
+import java.io.IOException
+import java.net.{InetSocketAddress, Socket, SocketTimeoutException}
+import java.nio.charset.StandardCharsets
+import scala.collection.mutable
+
+class MerkleKVException(message: String) extends RuntimeException(message)
+
+/** Server answered with an ERROR line. */
+class ServerException(message: String) extends MerkleKVException(message)
+
+/** Command round-trip exceeded the configured timeout. */
+class TimeoutException(message: String) extends MerkleKVException(message)
+
+object MerkleKVClient {
+  val DefaultPort = 7379
+
+  def defaultHost: String =
+    sys.env.getOrElse("MERKLEKV_HOST", "127.0.0.1")
+
+  def defaultPort: Int =
+    sys.env.get("MERKLEKV_PORT").flatMap(_.toIntOption).getOrElse(DefaultPort)
+
+  /** Command batch for [[MerkleKVClient.pipeline]]. */
+  final class Pipeline private[client] () {
+    private[client] val commands = mutable.ArrayBuffer.empty[String]
+
+    def set(key: String, value: String): Unit = commands += s"SET $key $value"
+    def get(key: String): Unit = commands += s"GET $key"
+    def delete(key: String): Unit = commands += s"DEL $key"
+  }
+}
+
+class MerkleKVClient(
+    host: String = MerkleKVClient.defaultHost,
+    port: Int = MerkleKVClient.defaultPort,
+    timeoutMillis: Int = 5000,
+) extends AutoCloseable {
+  import MerkleKVClient.Pipeline
+
+  private val sock = new Socket()
+  private val lock = new Object
+  private var buf = Array.emptyByteArray
+
+  sock.setTcpNoDelay(true)
+  sock.setSoTimeout(timeoutMillis)
+  try sock.connect(new InetSocketAddress(host, port), timeoutMillis)
+  catch {
+    case _: SocketTimeoutException =>
+      throw new TimeoutException(s"connect to $host:$port timed out")
+  }
+
+  override def close(): Unit = sock.close()
+
+  // -- basic ops ------------------------------------------------------------
+
+  /** None when the key is missing. */
+  def get(key: String): Option[String] = {
+    val resp = command(s"GET $key")
+    if (resp == "NOT_FOUND") None
+    else Some(expectPrefix(resp, "VALUE ", "GET"))
+  }
+
+  def set(key: String, value: String): Unit = {
+    val resp = command(s"SET $key $value")
+    if (resp != "OK") throw new ServerException(s"unexpected SET response: $resp")
+  }
+
+  /** True when the key existed. */
+  def delete(key: String): Boolean = command(s"DEL $key") == "DELETED"
+
+  // -- numeric / string ops -------------------------------------------------
+
+  def incr(key: String, delta: Long = 1): Long =
+    expectPrefix(command(s"INC $key $delta"), "VALUE ", "INC").toLong
+
+  def decr(key: String, delta: Long = 1): Long =
+    expectPrefix(command(s"DEC $key $delta"), "VALUE ", "DEC").toLong
+
+  def append(key: String, value: String): String =
+    expectPrefix(command(s"APPEND $key $value"), "VALUE ", "APPEND")
+
+  def prepend(key: String, value: String): String =
+    expectPrefix(command(s"PREPEND $key $value"), "VALUE ", "PREPEND")
+
+  // -- bulk / query ops -----------------------------------------------------
+
+  /** Map of found keys only (missing keys omitted). */
+  def mget(keys: String*): Map[String, String] = {
+    if (keys.isEmpty) return Map.empty
+    lock.synchronized {
+      writeLine(s"MGET ${keys.mkString(" ")}")
+      val first = readLineRaiseError()
+      if (first == "NOT_FOUND") return Map.empty
+      if (!first.startsWith("VALUES "))
+        throw new ServerException(s"unexpected MGET response: $first")
+      val out = mutable.LinkedHashMap.empty[String, String]
+      for (_ <- keys) {
+        val line = readLine()
+        val sp = line.indexOf(' ')
+        if (sp >= 0) {
+          val v = line.substring(sp + 1)
+          if (v != "NOT_FOUND") out(line.substring(0, sp)) = v
+        }
+      }
+      out.toMap
+    }
+  }
+
+  /** Values must not contain whitespace (MSET splits on runs); use `set`. */
+  def mset(pairs: Map[String, String]): Unit = {
+    if (pairs.isEmpty) return
+    val parts = pairs.flatMap { case (k, v) =>
+      require(!v.exists(_.isWhitespace), "MSET values must not contain whitespace")
+      Seq(k, v)
+    }
+    val resp = command(s"MSET ${parts.mkString(" ")}")
+    if (resp != "OK") throw new ServerException(s"unexpected MSET response: $resp")
+  }
+
+  def exists(keys: String*): Long =
+    expectPrefix(command(s"EXISTS ${keys.mkString(" ")}"), "EXISTS ", "EXISTS").toLong
+
+  /** Sorted keys with the prefix ("" = all). */
+  def scan(prefix: String = ""): List[String] = {
+    val cmd = if (prefix.isEmpty) "SCAN" else s"SCAN $prefix"
+    lock.synchronized {
+      writeLine(cmd)
+      val first = readLineRaiseError()
+      if (!first.startsWith("KEYS "))
+        throw new ServerException(s"unexpected SCAN response: $first")
+      val n = first.substring(5).toInt
+      List.fill(n)(readLine())
+    }
+  }
+
+  def dbsize(): Long =
+    expectPrefix(command("DBSIZE"), "DBSIZE ", "DBSIZE").toLong
+
+  /** Hex SHA-256 Merkle root of the keyspace (64 zeros when empty). */
+  def merkleRoot(pattern: String = ""): String = {
+    val cmd = if (pattern.isEmpty) "HASH" else s"HASH $pattern"
+    val resp = command(cmd)
+    val fields = resp.split(' ')
+    if (fields.headOption.contains("HASH") && fields.length >= 2) fields.last
+    else throw new ServerException(s"unexpected HASH response: $resp")
+  }
+
+  def truncate(): Unit = {
+    val resp = command("TRUNCATE")
+    if (resp != "OK") throw new ServerException(s"unexpected TRUNCATE response: $resp")
+  }
+
+  // -- admin ----------------------------------------------------------------
+
+  def ping(msg: String = ""): String = {
+    val resp = command(if (msg.isEmpty) "PING" else s"PING $msg")
+    if (!resp.startsWith("PONG"))
+      throw new ServerException(s"unexpected PING response: $resp")
+    resp.substring(4).dropWhile(_ == ' ')
+  }
+
+  def healthCheck(): Boolean =
+    try { ping("health"); true }
+    catch {
+      case _: MerkleKVException | _: IOException => false
+    }
+
+  def stats(): Map[String, String] = lock.synchronized {
+    writeLine("STATS")
+    val first = readLineRaiseError()
+    if (first != "STATS") throw new ServerException(s"unexpected STATS response: $first")
+    val out = mutable.LinkedHashMap.empty[String, String]
+    var line = readLine()
+    while (line != "END") {
+      val colon = line.indexOf(':')
+      if (colon >= 0) out(line.substring(0, colon)) = line.substring(colon + 1)
+      line = readLine()
+    }
+    out.toMap
+  }
+
+  def version(): String =
+    expectPrefix(command("VERSION"), "VERSION ", "VERSION")
+
+  // -- pipeline -------------------------------------------------------------
+
+  /**
+   * Batch single-line-response commands into one write; returns one raw
+   * response line per queued command.
+   *
+   *   val resps = c.pipeline { p => p.set("a", "1"); p.get("a") }
+   */
+  def pipeline(build: Pipeline => Unit): List[String] = {
+    val p = new Pipeline
+    build(p)
+    if (p.commands.isEmpty) return Nil
+    p.commands.foreach(checkArg)
+    lock.synchronized {
+      val payload = p.commands.map(_ + "\r\n").mkString
+      sock.getOutputStream.write(payload.getBytes(StandardCharsets.UTF_8))
+      List.fill(p.commands.size)(readLine())
+    }
+  }
+
+  // -- wire -----------------------------------------------------------------
+
+  private def checkArg(line: String): Unit =
+    require(!line.exists(c => c == '\r' || c == '\n'), "CR/LF forbidden in arguments")
+
+  private def writeLine(line: String): Unit = {
+    checkArg(line)
+    sock.getOutputStream.write((line + "\r\n").getBytes(StandardCharsets.UTF_8))
+  }
+
+  private def readLine(): String = {
+    val deadline = System.nanoTime() + timeoutMillis * 1000000L
+    while (true) {
+      val idx = buf.indexOf('\n'.toByte)
+      if (idx >= 0) {
+        val end = if (idx > 0 && buf(idx - 1) == '\r'.toByte) idx - 1 else idx
+        val line = new String(buf, 0, end, StandardCharsets.UTF_8)
+        buf = buf.drop(idx + 1)
+        return line
+      }
+      if (System.nanoTime() >= deadline)
+        throw new TimeoutException(s"timed out after ${timeoutMillis}ms")
+      val chunk = new Array[Byte](65536)
+      val n =
+        try sock.getInputStream.read(chunk)
+        catch {
+          case _: SocketTimeoutException =>
+            throw new TimeoutException(s"timed out after ${timeoutMillis}ms")
+        }
+      if (n < 0) throw new MerkleKVException("connection closed")
+      buf = buf ++ chunk.take(n)
+    }
+    throw new IllegalStateException("unreachable")
+  }
+
+  private def readLineRaiseError(): String = {
+    val resp = readLine()
+    if (resp.startsWith("ERROR ")) throw new ServerException(resp.substring(6))
+    resp
+  }
+
+  private def command(line: String): String = lock.synchronized {
+    writeLine(line)
+    readLineRaiseError()
+  }
+
+  private def expectPrefix(resp: String, prefix: String, verb: String): String = {
+    if (!resp.startsWith(prefix))
+      throw new ServerException(s"unexpected $verb response: $resp")
+    resp.substring(prefix.length)
+  }
+}

@@ -1,0 +1,85 @@
+/**
+ * Self-test against a live server. CI starts one and exports MERKLEKV_PORT;
+ * without a reachable server the program exits 0 with a SKIP line. Prints
+ * "SCALA CLIENT PASS" and exits 0 on success; exits 1 on first failure.
+ *
+ * Runnable without sbt:
+ *   scalac src/main/scala/io/merklekv/client/MerkleKVClient.scala \
+ *          src/test/scala/io/merklekv/client/ClientSelfTest.scala -d selftest
+ *   scala -cp selftest io.merklekv.client.ClientSelfTest
+ */
+
+package io.merklekv.client
+
+object ClientSelfTest {
+  private def check(cond: Boolean, what: String): Unit = {
+    if (!cond) {
+      System.err.println(s"FAIL: $what")
+      sys.exit(1)
+    }
+    println(s"ok - $what")
+  }
+
+  def main(args: Array[String]): Unit = {
+    val c =
+      try new MerkleKVClient(timeoutMillis = 10000)
+      catch {
+        case e: Exception =>
+          println(s"SKIP: no server reachable: ${e.getMessage}")
+          return
+      }
+
+    try {
+      c.set("sc:k1", "v1")
+      check(c.get("sc:k1").contains("v1"), "set/get")
+      check(c.delete("sc:k1"), "delete existing")
+      check(c.get("sc:k1").isEmpty, "get after delete")
+      check(!c.delete("sc:k1"), "delete missing")
+
+      val value = "hello world\twith tab"
+      c.set("sc:sp", value)
+      check(c.get("sc:sp").contains(value), "value with space+tab")
+
+      c.delete("sc:n")
+      check(c.incr("sc:n", 5) == 5L, "incr creates")
+      check(c.decr("sc:n", 2) == 3L, "decr")
+      c.delete("sc:s")
+      check(c.append("sc:s", "ab") == "ab", "append creates")
+      check(c.prepend("sc:s", "x") == "xab", "prepend")
+
+      c.mset(Map("sc:m1" -> "a", "sc:m2" -> "b"))
+      val got = c.mget("sc:m1", "sc:m2", "sc:nope")
+      check(got == Map("sc:m1" -> "a", "sc:m2" -> "b"), "mset/mget")
+      check(c.exists("sc:m1", "sc:m2", "sc:nope") == 2L, "exists")
+      check(c.scan("sc:m") == List("sc:m1", "sc:m2"), "scan prefix sorted")
+
+      val h1 = c.merkleRoot()
+      check(h1.length == 64, "merkle root is 64 hex chars")
+      c.set("sc:hk", System.nanoTime().toString)
+      check(c.merkleRoot() != h1, "root changes after write")
+
+      val resps = c.pipeline { p =>
+        p.set("sc:p1", "1")
+        p.set("sc:p2", "2")
+        p.get("sc:p1")
+        p.delete("sc:p2")
+      }
+      check(resps == List("OK", "OK", "VALUE 1", "DELETED"), "pipeline")
+
+      check(c.healthCheck(), "health check")
+      check(c.stats().contains("total_commands"), "stats has total_commands")
+      check(c.version().contains("."), "version has a dot")
+      check(c.dbsize() >= 0L, "dbsize")
+
+      c.set("sc:notnum", "abc")
+      val threw =
+        try { c.incr("sc:notnum", 1); false }
+        catch {
+          case e: ServerException => e.getMessage.contains("not a valid number")
+        }
+      check(threw, "INC on non-numeric raises ServerException")
+    } finally c.close()
+
+    println("SCALA CLIENT PASS")
+  }
+}

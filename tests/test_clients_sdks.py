@@ -1,10 +1,12 @@
-"""Go / Node.js SDK suites against a spawned native server.
+"""Client SDK suites against a spawned native server.
 
 Each SDK carries its own test suite (clients/go/client_test.go,
-clients/nodejs/test.js); this harness spawns one embedded server and runs
-them with MERKLEKV_PORT pointed at it — the reference's clients-ci.yml
+clients/nodejs/test.js, clients/php/test.php, clients/rust/tests/,
+clients/dotnet/ClientSelfTest.cs, clients/kotlin + clients/scala self-test
+mains, clients/elixir/test/); this harness spawns one embedded server and
+runs them with MERKLEKV_PORT pointed at it — the reference's clients-ci.yml
 pattern (/root/reference/.github/workflows/clients-ci.yml). Skipped when the
-toolchain isn't installed (this image has neither; CI does).
+toolchain isn't installed (this image has none of them; CI does).
 """
 
 import os
@@ -101,3 +103,126 @@ def test_ruby_client_suite(server_port):
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "0 failures, 0 errors, 0 skips" in r.stdout, r.stdout
+
+
+@pytest.mark.integration
+def test_php_client_suite(server_port):
+    php = shutil.which("php")
+    if php is None:
+        pytest.skip("php toolchain not installed")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [php, "test.php"],
+        cwd=os.path.join(REPO, "clients", "php"),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PHP CLIENT PASS" in r.stdout, r.stdout
+    assert "SKIP" not in r.stdout, "php suite skipped instead of running"
+
+
+@pytest.mark.integration
+def test_rust_client_suite(server_port):
+    cargo = shutil.which("cargo")
+    if cargo is None:
+        pytest.skip("rust toolchain not installed")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [cargo, "test", "--", "--nocapture"],
+        cwd=os.path.join(REPO, "clients", "rust"),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SKIP: no server reachable" not in (r.stdout + r.stderr), (
+        "rust suite skipped instead of running"
+    )
+
+
+@pytest.mark.integration
+def test_dotnet_client_suite(server_port):
+    dotnet = shutil.which("dotnet")
+    if dotnet is None:
+        pytest.skip("dotnet toolchain not installed")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [dotnet, "run"],
+        cwd=os.path.join(REPO, "clients", "dotnet"),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "DOTNET CLIENT PASS" in r.stdout, r.stdout
+
+
+@pytest.mark.integration
+def test_kotlin_client_suite(server_port, tmp_path):
+    kotlinc = shutil.which("kotlinc")
+    if kotlinc is None or shutil.which("java") is None:
+        pytest.skip("kotlin toolchain not installed")
+    kdir = os.path.join(REPO, "clients", "kotlin")
+    jar = str(tmp_path / "selftest.jar")
+    r = subprocess.run(
+        [kotlinc,
+         os.path.join(kdir, "src/main/kotlin/io/merklekv/client/MerkleKVClient.kt"),
+         os.path.join(kdir, "src/test/kotlin/io/merklekv/client/ClientSelfTest.kt"),
+         "-include-runtime", "-d", jar],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        ["java", "-jar", jar], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "KOTLIN CLIENT PASS" in r.stdout, r.stdout
+
+
+@pytest.mark.integration
+def test_scala_client_suite(server_port, tmp_path):
+    scalac = shutil.which("scalac")
+    if scalac is None or shutil.which("scala") is None:
+        pytest.skip("scala toolchain not installed")
+    sdir = os.path.join(REPO, "clients", "scala")
+    out = str(tmp_path / "selftest")
+    r = subprocess.run(
+        [scalac,
+         os.path.join(sdir, "src/main/scala/io/merklekv/client/MerkleKVClient.scala"),
+         os.path.join(sdir, "src/test/scala/io/merklekv/client/ClientSelfTest.scala"),
+         "-d", out],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        ["scala", "-cp", out, "io.merklekv.client.ClientSelfTest"], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SCALA CLIENT PASS" in r.stdout, r.stdout
+
+
+@pytest.mark.integration
+def test_elixir_client_suite(server_port):
+    elixir = shutil.which("elixir")
+    if elixir is None:
+        pytest.skip("elixir toolchain not installed")
+    env = dict(os.environ, MERKLEKV_PORT=str(server_port))
+    r = subprocess.run(
+        [elixir, "-r", "lib/merklekv.ex", "test/merklekv_test.exs"],
+        cwd=os.path.join(REPO, "clients", "elixir"),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ELIXIR CLIENT PASS" in r.stdout, r.stdout
