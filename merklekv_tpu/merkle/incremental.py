@@ -41,7 +41,11 @@ import jax
 import jax.numpy as jnp
 
 from merklekv_tpu.merkle.jax_engine import leaf_digests
-from merklekv_tpu.ops.dispatch import hash_node_pairs, use_pallas
+from merklekv_tpu.ops.dispatch import (
+    hash_node_level,
+    hash_node_pairs,
+    use_pallas,
+)
 from merklekv_tpu.ops.sha256 import digest_to_bytes, sha256_node_pairs
 
 __all__ = ["DeviceMerkleState"]
@@ -62,7 +66,10 @@ def _reduce_levels(leaves: jax.Array) -> tuple:
     levels = [leaves]
     cur = leaves
     while cur.shape[0] > 1:
-        cur = hash_node_pairs(cur[0::2], cur[1::2])
+        # Adjacent-pair level hash: capacity is a power of two, so every
+        # level is even and the contiguous level kernel applies throughout
+        # (no odd-promotion tail in the padded tree).
+        cur = hash_node_level(cur)
         levels.append(cur)
     return tuple(levels)
 

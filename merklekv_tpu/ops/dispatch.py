@@ -33,7 +33,13 @@ import jax
 
 from merklekv_tpu.ops.sha256 import sha256_blocks, sha256_node_pairs
 
-__all__ = ["use_pallas", "hash_blocks", "hash_node_pairs", "build_levels"]
+__all__ = [
+    "use_pallas",
+    "hash_blocks",
+    "hash_node_pairs",
+    "hash_node_level",
+    "build_levels",
+]
 
 
 def use_pallas() -> bool:
@@ -74,6 +80,24 @@ def hash_node_pairs(left: jax.Array, right: jax.Array) -> jax.Array:
         if not _interpreted() or left.shape[0] >= _MIN_PALLAS_PAIRS_INTERP:
             return node_pairs_pallas(left, right)
     return sha256_node_pairs(left, right)
+
+
+def hash_node_level(cur: jax.Array) -> jax.Array:
+    """[M, 8] tree level (M even) -> [M//2, 8] parents of ADJACENT pairs.
+
+    Semantically ``hash_node_pairs(cur[0::2], cur[1::2])``, but on TPU the
+    level kernel consumes adjacent rows via one contiguous reshape — the
+    even/odd strided split costs a relayout measured at ~17x the kernel
+    itself on a 5M-pair level (see sha256_pallas.node_level_pallas)."""
+    if use_pallas():
+        from merklekv_tpu.ops.sha256_pallas import (
+            _MIN_PALLAS_PAIRS_INTERP,
+            node_level_pallas,
+        )
+
+        if not _interpreted() or cur.shape[0] // 2 >= _MIN_PALLAS_PAIRS_INTERP:
+            return node_level_pallas(cur)
+    return sha256_node_pairs(cur[0::2], cur[1::2])
 
 
 def build_levels(leaves: jax.Array) -> list[jax.Array]:

@@ -57,8 +57,17 @@ def test_production_paths_route_through_dispatch(monkeypatch):
         calls["node"] += 1
         return sha256_node_pairs(left, right)
 
+    def spy_level(cur, interpret=None):
+        # The full build reduces levels via the adjacent-pair level kernel
+        # (hash_node_level); scatter/restructure still gather explicit
+        # left/right pairs through node_pairs_pallas.
+        calls["node"] += 1
+        p = cur.shape[0] // 2
+        return sha256_node_pairs(cur[0 : 2 * p : 2], cur[1 : 2 * p : 2])
+
     monkeypatch.setattr(sp, "leaf_digests_pallas", spy_leaf)
     monkeypatch.setattr(sp, "node_pairs_pallas", spy_node)
+    monkeypatch.setattr(sp, "node_level_pallas", spy_level)
     # Interp narrow-level fallback would bypass the node spy on CPU.
     monkeypatch.setattr(sp, "_MIN_PALLAS_PAIRS_INTERP", 0)
     monkeypatch.setenv("MKV_SHA256_BACKEND", "pallas")
@@ -120,6 +129,16 @@ def test_sharded_step_routes_through_dispatch(monkeypatch):
         lambda l, r, interpret=None: (
             calls.__setitem__("node", calls["node"] + 1),
             sha256_node_pairs(l, r),
+        )[1],
+    )
+    monkeypatch.setattr(
+        sp, "node_level_pallas",
+        lambda cur, interpret=None: (
+            calls.__setitem__("node", calls["node"] + 1),
+            sha256_node_pairs(
+                cur[0 : 2 * (cur.shape[0] // 2) : 2],
+                cur[1 : 2 * (cur.shape[0] // 2) : 2],
+            ),
         )[1],
     )
     monkeypatch.setattr(sp, "_MIN_PALLAS_PAIRS_INTERP", 0)
