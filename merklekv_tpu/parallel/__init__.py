@@ -1,5 +1,7 @@
-"""Multi-device SPMD layer: mesh construction, sharded Merkle build/diff."""
+"""Multi-device SPMD layer: mesh construction, sharded Merkle build/diff,
+multi-host (DCN) bootstrap."""
 
+from merklekv_tpu.parallel import multihost
 from merklekv_tpu.parallel.mesh import make_mesh
 from merklekv_tpu.parallel.sharded_merkle import (
     make_anti_entropy_step,
@@ -10,6 +12,7 @@ from merklekv_tpu.parallel.sharded_merkle import (
 
 __all__ = [
     "make_mesh",
+    "multihost",
     "sharded_tree_root",
     "sharded_divergence",
     "sharded_anti_entropy_step",

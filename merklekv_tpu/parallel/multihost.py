@@ -1,0 +1,147 @@
+"""Multi-host (DCN) bootstrap for the SPMD data plane.
+
+Inside one host/slice the keyspace mesh rides ICI (see mesh.py). Across
+hosts the SAME compiled program spans DCN: each process contributes its
+local devices to one global mesh, owns the keyspace rows that land on those
+devices, and the step's collectives (all_gather of subtree roots, psum of
+divergence counts) cross the host boundary transparently. This replaces the
+reference's multi-node fabric — per-key TCP pulls plus an MQTT broker
+(/root/reference/src/sync.rs:150-214, src/replication.rs:115-143) — with
+XLA collectives over ICI/DCN, the way a multi-host training step replaces a
+parameter server.
+
+Topology comes from ``initialize`` (explicit args or MKV_* env vars — the
+same env-first convention as config.py's credentials). After that, build a
+global mesh and lift each process's host-local rows into global arrays:
+
+    from merklekv_tpu.parallel import multihost
+    multihost.initialize()                      # no-op when single-process
+    mesh = multihost.global_key_mesh()
+    blocks, nblocks, digests, present = multihost.lift_local_shards(
+        mesh, blocks_local, nblocks_local, digests_local, present_local)
+    root, masks, counts = sharded_anti_entropy_step(
+        mesh, blocks, nblocks, digests, present)
+
+Every process gets the same replicated root/counts; ``masks`` stays
+keyspace-sharded, each process addressing only its own rows.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from merklekv_tpu.parallel.mesh import make_mesh
+
+__all__ = [
+    "initialize",
+    "is_initialized",
+    "process_count",
+    "process_index",
+    "global_key_mesh",
+    "lift_local_shards",
+]
+
+_initialized = False
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join (or form) the jax distributed cluster.
+
+    Args fall back to ``MKV_COORDINATOR`` (host:port of process 0),
+    ``MKV_NUM_PROCESSES``, and ``MKV_PROCESS_ID``. With no coordinator
+    configured (the single-host case) this is a no-op — every helper below
+    degrades to plain single-process behavior, so callers can invoke it
+    unconditionally at startup.
+
+    Must run before the first device touch in the process (the same rule as
+    jax.distributed.initialize, which this wraps).
+    """
+    global _initialized
+    coordinator = coordinator or os.environ.get("MKV_COORDINATOR", "")
+    if not coordinator or _initialized:
+        return
+    num_processes = num_processes or int(os.environ["MKV_NUM_PROCESSES"])
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ["MKV_PROCESS_ID"])
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_key_mesh(replicas: int = 0) -> Mesh:
+    """Mesh over ALL devices in the cluster (every process's contribution).
+
+    Default: one ``key`` axis (keyspace data parallelism spanning DCN).
+    ``replicas > 0`` adds a leading ``replica`` axis of that size.
+    """
+    n = len(jax.devices())
+    if replicas > 0:
+        if n % replicas:
+            raise ValueError(
+                f"{n} devices not divisible by replicas={replicas}"
+            )
+        return make_mesh({"replica": replicas, "key": n // replicas})
+    return make_mesh({"key": n})
+
+
+def lift_local_shards(
+    mesh: Mesh,
+    blocks_local,
+    nblocks_local,
+    digests_local,
+    present_local,
+    axis: str = "key",
+):
+    """Host-local anti-entropy inputs -> global arrays on the mesh.
+
+    Each process passes the rows IT owns: ``blocks_local [n_local, B, 16]``,
+    ``nblocks_local [n_local]``, ``digests_local [R, n_local, 8]``,
+    ``present_local [R, n_local]`` — where n_local is its contiguous slice
+    of the sorted global keyspace, in process order (process 0 owns the
+    first slice). Global shapes are the concatenation; replica-major arrays
+    shard on their key dimension and replicate over R.
+
+    Single-process (mesh confined to local devices): a plain device_put
+    with the same shardings — identical call sites either way.
+    """
+    shardings = (
+        NamedSharding(mesh, P(axis, None, None)),   # blocks
+        NamedSharding(mesh, P(axis)),               # nblocks
+        NamedSharding(mesh, P(None, axis, None)),   # digests
+        NamedSharding(mesh, P(None, axis)),         # present
+    )
+    locals_ = (blocks_local, nblocks_local, digests_local, present_local)
+    if jax.process_count() == 1:
+        return tuple(
+            jax.device_put(arr, s) for arr, s in zip(locals_, shardings)
+        )
+    return tuple(
+        jax.make_array_from_process_local_data(s, arr)
+        for arr, s in zip(locals_, shardings)
+    )
