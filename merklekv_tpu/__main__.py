@@ -28,6 +28,17 @@ def main(argv: list[str] | None = None) -> int:
     from merklekv_tpu.native_bindings import NativeEngine, NativeServer
     from merklekv_tpu.version import __version__
 
+    # Join the multi-host jax cluster BEFORE any device touch when
+    # MKV_COORDINATOR is set — the device data plane then runs over the
+    # global mesh (docs/DEPLOYMENT.md "Multi-host"). Gated on the env var so
+    # a bare node never pays the jax import at startup.
+    import os
+
+    if os.environ.get("MKV_COORDINATOR"):
+        from merklekv_tpu.parallel import multihost
+
+        multihost.initialize()
+
     cfg = load_or_default(args.config)
     if args.engine:
         cfg.engine = args.engine

@@ -208,7 +208,12 @@ class DeviceTreeMirror:
 
         from merklekv_tpu.parallel.mesh import make_mesh
 
-        devs = jax.devices()
+        # LOCAL devices only: the mirror is a per-node structure driven by
+        # this node's event stream, not an SPMD program — under a
+        # multi-host jax cluster (parallel/multihost.py) jax.devices()
+        # includes other hosts' non-addressable chips, and a device_put
+        # onto those would fail or deadlock.
+        devs = jax.local_devices()
         n = 1 << (len(devs).bit_length() - 1)  # largest pow2 <= len(devs)
         mesh = make_mesh({"key": n}, devices=devs[:n])
         return NamedSharding(mesh, PartitionSpec("key", None))

@@ -68,12 +68,23 @@ def initialize(
     coordinator = coordinator or os.environ.get("MKV_COORDINATOR", "")
     if not coordinator or _initialized:
         return
-    num_processes = num_processes or int(os.environ["MKV_NUM_PROCESSES"])
-    process_id = (
-        process_id
-        if process_id is not None
-        else int(os.environ["MKV_PROCESS_ID"])
-    )
+    if num_processes is None:
+        env = os.environ.get("MKV_NUM_PROCESSES")
+        if env is None:
+            raise ValueError(
+                "multihost.initialize: coordinator is set but the process "
+                "count is not — pass num_processes or set MKV_NUM_PROCESSES"
+            )
+        num_processes = int(env)
+    if process_id is None:
+        env = os.environ.get("MKV_PROCESS_ID")
+        if env is None:
+            raise ValueError(
+                "multihost.initialize: coordinator is set but this "
+                "process's rank is not — pass process_id or set "
+                "MKV_PROCESS_ID"
+            )
+        process_id = int(env)
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

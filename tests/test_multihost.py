@@ -145,3 +145,16 @@ def test_two_process_cluster_agrees_with_golden(tmp_path):
     values = [b"val-%d" % i for i in range(N_GLOBAL)]
     golden = build_levels([leaf_hash(k, v) for k, v in zip(keys, values)])[-1][0]
     assert roots[0] == golden.hex()
+
+
+def test_initialize_requires_full_topology(monkeypatch):
+    """Coordinator without process count / rank must fail with a clear
+    configuration error, not a raw KeyError."""
+    from merklekv_tpu.parallel import multihost
+
+    monkeypatch.delenv("MKV_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("MKV_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="MKV_NUM_PROCESSES"):
+        multihost.initialize(coordinator="127.0.0.1:1")
+    with pytest.raises(ValueError, match="MKV_PROCESS_ID"):
+        multihost.initialize(coordinator="127.0.0.1:1", num_processes=2)
