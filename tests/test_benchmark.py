@@ -12,6 +12,7 @@ The native server clears these floors by orders of magnitude; the asserts
 keep the SAME numbers as the reference so regressions trip the same wire.
 """
 
+import os
 import threading
 import time
 
@@ -160,3 +161,24 @@ def test_pipeline_throughput(server):
         ops_s = len(cmds) / wall
         print(f"\npipelined SET: {ops_s:,.0f} ops/s")
         assert ops_s > 10_000  # reference's claimed sustained throughput
+
+
+@pytest.mark.benchmark
+def test_kernel_bench_tool_smoke(monkeypatch, capfd):
+    """tools/kernel_bench.py runs end-to-end off-TPU and emits valid JSON
+    rows for the scan baselines (the Pallas rows are chip-only)."""
+    import json
+    import runpy
+
+    monkeypatch.setenv("MKV_KB_REPS", "2")
+    runpy.run_path(
+        os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                     "tools", "kernel_bench.py"),
+        run_name="__main__",
+    )
+    out = capfd.readouterr().out
+    rows = [json.loads(line) for line in out.strip().splitlines()]
+    kernels = {r["kernel"] for r in rows}
+    assert {"sha256_blocks_scan", "sha256_node_pairs_scan",
+            "build_levels_dispatch"} <= kernels
+    assert all(r["ms"] > 0 for r in rows)

@@ -38,13 +38,15 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _time_salted(make_step, reps: int = 20) -> float:
+def _time_salted(make_step, reps: int | None = None) -> float:
     """Median wall seconds per call.
 
     ``make_step() -> (step, salt0)`` where ``step(salt) -> out`` is jitted,
     folds the salt into its input, and returns an array whose first row
     feeds the next rep's salt. Sync is the 1-row fetch of that output.
     """
+    if reps is None:
+        reps = int(os.environ.get("MKV_KB_REPS", "20"))
     step, salt = make_step()
     out = step(salt)
     np.asarray(out[:1])  # compile + sync
