@@ -163,7 +163,12 @@ def test_pipeline_throughput(server):
         assert ops_s > 10_000  # reference's claimed sustained throughput
 
 
-@pytest.mark.benchmark
+@pytest.mark.skipif(
+    __import__("jax").default_backend() == "tpu",
+    reason="smoke run is the off-TPU path; on-chip kernels are covered by "
+    "tests/test_sha256_pallas.py, and the full 4M-leaf bench does not "
+    "belong inside the suite",
+)
 def test_kernel_bench_tool_smoke(monkeypatch, capfd):
     """tools/kernel_bench.py runs end-to-end off-TPU and emits valid JSON
     rows for the scan baselines (the Pallas rows are chip-only)."""

@@ -235,3 +235,74 @@ def test_persistence_across_restart(tmp_path):
     finally:
         p2.terminate()
         p2.wait(timeout=5)
+
+
+def test_crash_recovery_prefix_consistency(tmp_path):
+    """SIGKILL while writes are still streaming, then restart on the log.
+
+    The durable engine appends each record with a raw write() BEFORE the
+    server sends OK, so under a hard process kill (no SHUTDOWN, no flush)
+    every ACKNOWLEDGED write must survive replay; an un-acked in-flight
+    record may or may not land. Recovery must also be write-order
+    contiguous — nothing corrupted, reordered, or resurrected."""
+    import threading
+
+    data = tmp_path / "data"
+    p = _spawn(
+        ["-m", "merklekv_tpu", "--port", "0", "--engine", "log",
+         "--storage-path", str(data)]
+    )
+    port = _port_from(p)
+    _wait_port(port)
+    acked = 0
+    done = threading.Event()
+
+    def writer():
+        nonlocal acked
+        try:
+            with MerkleKVClient("127.0.0.1", port) as c:
+                for i in range(100_000):
+                    c.set(f"cr:{i:06d}", f"val-{i}")
+                    acked += 1
+        except Exception:
+            pass  # connection dies at the kill — expected
+        finally:
+            done.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    deadline = time.time() + 10
+    while acked < 200 and time.time() < deadline:
+        time.sleep(0.005)
+    p.kill()  # SIGKILL mid-stream: no shutdown path, no engine close
+    p.wait(timeout=10)
+    done.wait(timeout=10)
+    t.join(timeout=10)
+    assert acked >= 200, f"writer only got {acked} acks before the deadline"
+
+    p2 = _spawn(
+        ["-m", "merklekv_tpu", "--port", "0", "--engine", "log",
+         "--storage-path", str(data)]
+    )
+    port2 = _port_from(p2)
+    _wait_port(port2)
+    try:
+        with MerkleKVClient("127.0.0.1", port2) as c:
+            keys = c.scan("cr:")
+            recovered = {k: c.get(k) for k in keys}
+        # Every acked write survived (ack implies the record hit the fd).
+        assert len(recovered) >= acked, (len(recovered), acked)
+        # Values exact.
+        for k, v in recovered.items():
+            i = int(k.split(":")[1])
+            assert v == f"val-{i}", (k, v)
+        # Write-order contiguity: indices are exactly 0..len-1 (at most
+        # one un-acked in-flight record beyond the acked prefix).
+        idxs = sorted(int(k.split(":")[1]) for k in recovered)
+        assert idxs == list(range(len(idxs))), (
+            f"recovery gap: {len(idxs)} keys, max {idxs[-1] if idxs else None}"
+        )
+        assert len(idxs) <= acked + 1
+    finally:
+        p2.terminate()
+        p2.wait(timeout=5)
