@@ -408,3 +408,60 @@ def test_protocol_fuzz_survives_garbage(server):
         assert len(c.hash()) == 64
     finally:
         c.close()
+
+
+def test_rapid_connect_disconnect_churn(server):
+    """Connection lifecycle stress: 150 connects, a third dropped with a
+    half-written line, a third closed immediately, a third doing one real
+    command — then the server must still serve and its CLIENT LIST must
+    not leak dead connections."""
+    import socket as socket_mod
+
+    for i in range(150):
+        s = socket_mod.create_connection(("127.0.0.1", server.port), timeout=5)
+        mode = i % 3
+        if mode == 0:
+            s.close()  # immediate drop
+        elif mode == 1:
+            s.sendall(b"SET half:key half-a-line-with-no-termina")
+            s.close()  # torn mid-line
+        else:
+            s.sendall(b"PING\r\n")
+            s.settimeout(5)
+            assert s.recv(64).startswith(b"PONG")
+            s.close()
+
+    c = MerkleKVClient("127.0.0.1", server.port).connect()
+    try:
+        c.set("churn:alive", "yes")
+        assert c.get("churn:alive") == "yes"
+        # No half-written SET may have committed.
+        assert c.get("half:key") is None
+        # Handler threads reaped: the live-connection table holds only this
+        # client (plus possibly a raced, not-yet-reaped drop or two).
+        lines = c.client_list()
+        assert len(lines) <= 5, lines
+    finally:
+        c.close()
+
+
+def test_unicode_keys_and_values_roundtrip(client):
+    """UTF-8 text protocol: multibyte keys and values round-trip exactly
+    and feed HASH/LEAFHASHES without error (reference parity:
+    tests/integration/test_error_handling.py unicode cases)."""
+    pairs = {
+        "uni:café": "crème brûlée",
+        "uni:日本語": "値-こんにちは",
+        "uni:emoji": "🚀 0x1F680 🎉",
+        "uni:mixed": "Ωμέγα Ω tail",
+    }
+    for k, v in pairs.items():
+        client.set(k, v)
+    for k, v in pairs.items():
+        assert client.get(k) == v
+    assert client.exists(*pairs.keys()) == len(pairs)
+    assert sorted(client.scan("uni:")) == sorted(pairs.keys())
+    root = client.hash()
+    assert len(root) == 64
+    client.set("uni:café", "changed")
+    assert client.hash() != root
