@@ -25,6 +25,7 @@ import jax.numpy as jnp
 __all__ = [
     "AlignedReplicas",
     "align_replicas",
+    "divergence_vs_ref",
     "divergence_masks",
     "diff_keys_multi",
     "diff_keys_pair",
@@ -75,29 +76,32 @@ def align_replicas(replicas: Sequence[dict[bytes, bytes]]) -> AlignedReplicas:
     return AlignedReplicas(keys, digests, present)
 
 
-@jax.jit
+def divergence_vs_ref(digests, present, ref_d, ref_p):
+    """THE divergence predicate, in one place: a key diverges iff presence
+    differs or both present with different digests. Polymorphic over numpy
+    and jax arrays (method-call formulation, no jnp/np entry points) so the
+    device programs and the host twin cannot drift apart. Deliberately NOT
+    jitted: divergence_masks_np must stay pure-host (spawned server
+    processes may not initialize an accelerator backend), and the device
+    callers already jit at their own program boundaries."""
+    same_digest = (digests == ref_d).all(axis=-1)
+    both_present = present & ref_p
+    return (present != ref_p) | (both_present & ~same_digest)
+
+
 def divergence_masks(digests: jax.Array, present: jax.Array) -> jax.Array:
     """[R, N] bool: key i diverges between replica r and replica 0.
 
-    A key diverges if presence differs or both present with different
-    digests. Row 0 is all-False by construction.
+    Row 0 is all-False by construction.
     """
-    ref_d = digests[0:1]
-    ref_p = present[0:1]
-    same_digest = jnp.all(digests == ref_d, axis=-1)
-    both_present = present & ref_p
-    return (present != ref_p) | (both_present & ~same_digest)
+    return divergence_vs_ref(digests, present, digests[0:1], present[0:1])
 
 
 def divergence_masks_np(digests: np.ndarray, present: np.ndarray) -> np.ndarray:
     """Host-side twin of :func:`divergence_masks` for small keyspaces where
     initializing an accelerator backend is not worth it (and, in spawned
     server processes, must be avoided unless explicitly configured)."""
-    ref_d = digests[0:1]
-    ref_p = present[0:1]
-    same_digest = (digests == ref_d).all(axis=-1)
-    both_present = present & ref_p
-    return (present != ref_p) | (both_present & ~same_digest)
+    return divergence_vs_ref(digests, present, digests[0:1], present[0:1])
 
 
 @jax.jit

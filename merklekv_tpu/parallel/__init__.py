@@ -7,6 +7,7 @@ from merklekv_tpu.parallel.sharded_merkle import (
     make_anti_entropy_step,
     sharded_anti_entropy_step,
     sharded_divergence,
+    sharded_divergence_2d,
     sharded_tree_root,
 )
 
@@ -15,6 +16,7 @@ __all__ = [
     "multihost",
     "sharded_tree_root",
     "sharded_divergence",
+    "sharded_divergence_2d",
     "sharded_anti_entropy_step",
     "make_anti_entropy_step",
 ]

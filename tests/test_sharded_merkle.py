@@ -144,3 +144,46 @@ def test_sharded_divergence_matches_local():
     np.testing.assert_array_equal(
         np.asarray(counts), local.sum(axis=1).astype(np.int32)
     )
+
+
+@pytest.mark.parametrize("dr,dk,r,n", [(2, 4, 4, 16), (2, 2, 6, 8), (4, 2, 4, 64)])
+def test_divergence_2d_matches_1d_and_host(dr, dk, r, n):
+    """2-D (replica x key) sharded divergence is bit-identical to the
+    host-side golden mask and to the key-only sharded program."""
+    from merklekv_tpu.merkle.diff import divergence_masks_np
+    from merklekv_tpu.parallel.sharded_merkle import sharded_divergence_2d
+
+    rng = np.random.RandomState(17)
+    base = rng.randint(0, 2**32, size=(1, n, 8), dtype=np.uint64).astype(np.uint32)
+    digests = np.tile(base, (r, 1, 1))
+    present = np.ones((r, n), bool)
+    # Divergent digests + presence asymmetries in both directions.
+    digests[1, 0, 0] ^= 1
+    digests[r - 1, n - 1, 3] ^= 7
+    present[1, 2] = False          # missing on replica 1
+    present[0, 3] = False          # missing on the reference
+    present[:, 4] = False          # missing everywhere (no divergence)
+
+    mesh = make_mesh({"replica": dr, "key": dk},
+                     devices=jax.devices()[: dr * dk])
+    masks, counts = sharded_divergence_2d(mesh, digests, present)
+    masks, counts = np.asarray(masks), np.asarray(counts)
+
+    golden = divergence_masks_np(digests, present)
+    np.testing.assert_array_equal(masks, golden)
+    np.testing.assert_array_equal(counts, golden.sum(axis=1).astype(np.int32))
+    assert not masks[0].any()  # reference row self-compares clean
+
+
+def test_divergence_2d_rejects_bad_shapes():
+    from merklekv_tpu.parallel.sharded_merkle import sharded_divergence_2d
+
+    mesh = make_mesh({"replica": 2, "key": 4})
+    digests = np.zeros((3, 16, 8), np.uint32)  # 3 % 2 != 0
+    present = np.ones((3, 16), bool)
+    with pytest.raises(ValueError, match="replica count"):
+        sharded_divergence_2d(mesh, digests, present)
+    digests = np.zeros((2, 15, 8), np.uint32)  # 15 % 4 != 0
+    present = np.ones((2, 15), bool)
+    with pytest.raises(ValueError, match="key count"):
+        sharded_divergence_2d(mesh, digests, present)
