@@ -146,7 +146,17 @@ def test_sharded_divergence_matches_local():
     )
 
 
-@pytest.mark.parametrize("dr,dk,r,n", [(2, 4, 4, 16), (2, 2, 6, 8), (4, 2, 4, 64)])
+@pytest.mark.parametrize(
+    "dr,dk,r,n",
+    [
+        (2, 4, 4, 16),
+        (2, 2, 6, 8),
+        (4, 2, 4, 64),
+        # BASELINE config 5's replica scale: 64 replicas sharded 4-ways on
+        # the replica axis (16 digest rows per device instead of 64).
+        (4, 2, 64, 8),
+    ],
+)
 def test_divergence_2d_matches_1d_and_host(dr, dk, r, n):
     """2-D (replica x key) sharded divergence is bit-identical to the
     host-side golden mask and to the key-only sharded program."""
