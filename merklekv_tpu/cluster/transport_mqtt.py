@@ -12,9 +12,10 @@ Implemented subset (all of what replication needs):
   PUBLISH QoS-0 in both directions, SUBSCRIBE/SUBACK with a trailing
   multi-level wildcard, PINGREQ/PINGRESP keepalive, DISCONNECT.
 
-``StubMqttBroker`` is a frame-accurate in-process broker for tests: real
-MQTT framing on real sockets, CONNACK/SUBACK/fan-out semantics — enough to
-prove interop without an external mosquitto (none exists in this image).
+``MqttBroker`` is a frame-accurate MQTT 3.1.1 broker (QoS-0 fan-out,
+'#'/'+' filters): CLI-runnable via ``python -m merklekv_tpu.broker
+--protocol mqtt`` so an all-MQTT cluster runs self-contained, and used
+in-process by the interop tests (no external mosquitto in this image).
 """
 
 from __future__ import annotations
@@ -25,7 +26,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["MqttTransport", "StubMqttBroker"]
+__all__ = ["MqttTransport", "MqttBroker", "StubMqttBroker"]
 
 Callback = Callable[[str, bytes], None]
 
@@ -35,6 +36,10 @@ _CONNACK = 0x20
 _PUBLISH = 0x30
 _SUBSCRIBE = 0x82  # QoS-1 control packet per spec (required flags 0b0010)
 _SUBACK = 0x90
+_PUBACK = 0x40
+_PUBREC = 0x50
+_PUBREL = 0x60  # client frame arrives with required flags 0b0010 (0x62)
+_PUBCOMP = 0x70
 _PINGREQ = 0xC0
 _PINGRESP = 0xD0
 _DISCONNECT = 0xE0
@@ -248,12 +253,13 @@ class MqttTransport:
                         self.callback_errors += 1
 
 
-class StubMqttBroker:
-    """Frame-accurate MQTT 3.1.1 broker for tests (QoS-0 fan-out).
+class MqttBroker:
+    """Frame-accurate MQTT 3.1.1 broker (QoS-0 fan-out).
 
     Speaks real wire frames on real sockets: CONNECT->CONNACK,
     SUBSCRIBE->SUBACK, PUBLISH fan-out honoring '#'/'+' filters,
-    PINGREQ->PINGRESP. No retained messages, sessions, or QoS>0 flows."""
+    PINGREQ->PINGRESP. No retained messages, sessions, or QoS>0 flows —
+    the event fabric is QoS-0 by design (anti-entropy repairs drops)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -286,48 +292,76 @@ class StubMqttBroker:
             ).start()
 
     def _serve(self, cid: int, sock: socket.socket) -> None:
-        while True:
-            pkt = _read_packet(sock)
-            if pkt is None:
-                break
-            header, body = pkt
-            ptype = header & 0xF0
-            if ptype == _CONNECT & 0xF0:
-                self.connects += 1
-                self._send(cid, bytes([_CONNACK, 2, 0, 0]))
-            elif ptype == _SUBSCRIBE & 0xF0:
-                pid = body[:2]
-                filters, rcs = [], b""
-                off = 2
-                while off + 2 <= len(body):
-                    (flen,) = struct.unpack(">H", body[off : off + 2])
-                    f = body[off + 2 : off + 2 + flen].decode("utf-8")
-                    off += 2 + flen + 1  # + requested QoS byte
-                    filters.append(f)
-                    rcs += b"\x00"  # granted QoS 0
-                with self._mu:
-                    if cid in self._clients:
-                        self._clients[cid][2].extend(filters)
-                suback = pid + rcs
-                self._send(
-                    cid,
-                    bytes([_SUBACK]) + _encode_varlen(len(suback)) + suback,
-                )
-            elif ptype == _PUBLISH:
-                self.publishes += 1
-                (tlen,) = struct.unpack(">H", body[:2])
-                topic = body[2 : 2 + tlen].decode("utf-8", "surrogateescape")
-                frame = bytes([_PUBLISH]) + _encode_varlen(len(body)) + body
-                with self._mu:
-                    targets = list(self._clients.items())
-                for tid, (_s, _lk, filters) in targets:
-                    if any(_topic_matches(f, topic) for f in filters):
-                        self._send(tid, frame)
-            elif ptype == _PINGREQ & 0xF0:
-                self._send(cid, bytes([_PINGRESP, 0]))
-            elif ptype == _DISCONNECT & 0xF0:
-                break
+        try:
+            while True:
+                pkt = _read_packet(sock)
+                if pkt is None:
+                    break
+                if not self._handle_packet(cid, *pkt):
+                    break
+        except Exception:
+            # A malformed frame must cost the SENDER its connection, never
+            # the broker: fall through to the cleanup either way.
+            pass
         self._drop(cid)
+
+    def _handle_packet(self, cid: int, header: int, body: bytes) -> bool:
+        """One control packet; False ends the connection."""
+        ptype = header & 0xF0
+        if ptype == _CONNECT & 0xF0:
+            self.connects += 1
+            self._send(cid, bytes([_CONNACK, 2, 0, 0]))
+        elif ptype == _SUBSCRIBE & 0xF0:
+            pid = body[:2]
+            filters, rcs = [], b""
+            off = 2
+            while off + 2 <= len(body):
+                (flen,) = struct.unpack(">H", body[off : off + 2])
+                f = body[off + 2 : off + 2 + flen].decode("utf-8")
+                off += 2 + flen + 1  # + requested QoS byte
+                filters.append(f)
+                rcs += b"\x00"  # granted QoS 0
+            with self._mu:
+                if cid in self._clients:
+                    self._clients[cid][2].extend(filters)
+            suback = pid + rcs
+            self._send(
+                cid,
+                bytes([_SUBACK]) + _encode_varlen(len(suback)) + suback,
+            )
+        elif ptype == _PUBLISH:
+            self.publishes += 1
+            qos = (header >> 1) & 0x03
+            (tlen,) = struct.unpack(">H", body[:2])
+            topic = body[2 : 2 + tlen].decode("utf-8", "surrogateescape")
+            payload_off = 2 + tlen
+            if qos:
+                # QoS>0 sender (e.g. mosquitto_pub -q 1): acknowledge, and
+                # strip the packet id so subscribers get a clean QoS-0
+                # body — leaving it would prepend 2 stray bytes to every
+                # fanned-out payload.
+                pid_bytes = body[payload_off : payload_off + 2]
+                payload_off += 2
+                if qos == 1:
+                    self._send(cid, bytes([_PUBACK, 2]) + pid_bytes)
+                else:  # QoS 2: PUBREC now, PUBCOMP on the sender's PUBREL
+                    self._send(cid, bytes([_PUBREC, 2]) + pid_bytes)
+            out_body = body[:2] + body[2 : 2 + tlen] + body[payload_off:]
+            frame = (
+                bytes([_PUBLISH]) + _encode_varlen(len(out_body)) + out_body
+            )
+            with self._mu:
+                targets = list(self._clients.items())
+            for tid, (_s, _lk, filters) in targets:
+                if any(_topic_matches(f, topic) for f in filters):
+                    self._send(tid, frame)
+        elif ptype == _PUBREL & 0xF0:
+            self._send(cid, bytes([_PUBCOMP, 2]) + body[:2])
+        elif ptype == _PINGREQ & 0xF0:
+            self._send(cid, bytes([_PINGRESP, 0]))
+        elif ptype == _DISCONNECT & 0xF0:
+            return False
+        return True
 
     def _send(self, cid: int, frame: bytes) -> None:
         with self._mu:
@@ -364,3 +398,7 @@ class StubMqttBroker:
                 s.close()
             except OSError:
                 pass
+
+
+# Historical name from when the broker lived test-side only.
+StubMqttBroker = MqttBroker

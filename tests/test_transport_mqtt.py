@@ -1,6 +1,6 @@
 """MQTT 3.1.1 transport (VERDICT r4 item 6).
 
-Frame-level tests against StubMqttBroker (real MQTT wire frames on real
+Frame-level tests against MqttBroker (real MQTT wire frames on real
 sockets — CONNACK/SUBACK/PUBLISH fan-out/PINGRESP), plus end-to-end
 replication between two ClusterNodes whose fabric is `transport = "mqtt"`.
 """
@@ -14,14 +14,14 @@ import pytest
 
 from merklekv_tpu.cluster.transport_mqtt import (
     MqttTransport,
-    StubMqttBroker,
+    MqttBroker,
     _topic_matches,
 )
 
 
 @pytest.fixture
 def broker():
-    b = StubMqttBroker()
+    b = MqttBroker()
     yield b
     b.close()
 
@@ -189,3 +189,146 @@ def test_unknown_transport_kind_rejected():
 
     with pytest.raises(ValueError, match="unknown replication transport"):
         make_transport("somehost", 1883, kind="MQTT")  # typo'd case
+
+
+def _raw_connect(broker) -> socket.socket:
+    """Minimal third-party-style MQTT client: CONNECT and eat the CONNACK."""
+    from merklekv_tpu.cluster.transport_mqtt import _encode_varlen, _utf8
+
+    s = socket.create_connection((broker.host, broker.port), timeout=5)
+    var = _utf8("MQTT") + bytes([4, 0x02]) + struct.pack(">H", 30)
+    body = var + _utf8(f"raw-{uuid.uuid4().hex[:8]}")
+    s.sendall(bytes([0x10]) + _encode_varlen(len(body)) + body)
+    ack = s.recv(4)
+    assert ack == bytes([0x20, 2, 0, 0]), ack
+    return s
+
+
+def test_qos1_publish_from_third_party_client(broker):
+    """A QoS-1 publisher (mosquitto_pub -q 1 style) gets a PUBACK, and
+    subscribers receive a CLEAN QoS-0 body — no stray packet-id bytes."""
+    from merklekv_tpu.cluster.transport_mqtt import _encode_varlen, _utf8
+
+    got = []
+    sub = MqttTransport(broker.host, broker.port, client_id="q1sub")
+    try:
+        sub.subscribe("q1/events", lambda t, p: got.append((t, p)))
+        time.sleep(0.05)
+        raw = _raw_connect(broker)
+        try:
+            body = _utf8("q1/events/k") + struct.pack(">H", 77) + b"payload-q1"
+            raw.sendall(bytes([0x32]) + _encode_varlen(len(body)) + body)
+            puback = raw.recv(4)
+            assert puback == bytes([0x40, 2, 0, 77]), puback
+            assert wait_for(lambda: got == [("q1/events/k", b"payload-q1")]), got
+        finally:
+            raw.close()
+    finally:
+        sub.close()
+
+
+def test_malformed_frame_drops_sender_only(broker):
+    """An empty-body PUBLISH (malformed: no topic length) must cost the
+    sender its connection and nothing else — the broker keeps serving."""
+    bad = _raw_connect(broker)
+    bad.sendall(bytes([0x30, 0x00]))  # PUBLISH, remaining length 0
+    # Broker closes the offender (recv sees EOF within the timeout).
+    bad.settimeout(5)
+    assert bad.recv(16) == b""
+    bad.close()
+
+    got = []
+    t1 = MqttTransport(broker.host, broker.port, client_id="after-bad-1")
+    t2 = MqttTransport(broker.host, broker.port, client_id="after-bad-2")
+    try:
+        t2.subscribe("ok/events", lambda t, p: got.append(p))
+        time.sleep(0.05)
+        t1.publish("ok/events", b"still-alive")
+        assert wait_for(lambda: got == [b"still-alive"])
+    finally:
+        t1.close()
+        t2.close()
+
+
+@pytest.mark.integration
+def test_mqtt_broker_cli_cluster_replicates(tmp_path):
+    """All-MQTT cluster, fully self-contained: the CLI broker in --protocol
+    mqtt mode plus two server processes configured with transport="mqtt"
+    must replicate writes end-to-end through real MQTT 3.1.1 frames."""
+    import os
+    import subprocess
+    import sys
+
+    from merklekv_tpu.client import MerkleKVClient
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Server processes must not race for the single tunneled TPU.
+    env = dict(os.environ, PYTHONPATH=repo, MERKLEKV_JAX_PLATFORM="cpu")
+    procs = []
+
+    def spawn(args):
+        p = subprocess.Popen(
+            [sys.executable, *args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        procs.append(p)
+        return p
+
+    try:
+        broker = spawn(["-m", "merklekv_tpu.broker", "--port", "0",
+                        "--protocol", "mqtt"])
+        line = broker.stdout.readline()
+        assert "(mqtt) listening on" in line, line
+        broker_port = int(line.rsplit(":", 1)[1].split()[0])
+
+        ports = []
+        for i in (1, 2):
+            cfg = tmp_path / f"node{i}.toml"
+            cfg.write_text(f"""
+host = "127.0.0.1"
+port = 0
+engine = "mem"
+
+[replication]
+enabled = true
+transport = "mqtt"
+mqtt_broker = "127.0.0.1"
+mqtt_port = {broker_port}
+topic_prefix = "mqtt_itest"
+client_id = "mq-node-{i}"
+""")
+            p = spawn(["-m", "merklekv_tpu", "--config", str(cfg)])
+            line = p.stdout.readline()
+            assert "listening on" in line, line
+            ports.append(int(line.rsplit(":", 1)[1].split()[0]))
+
+        with MerkleKVClient("127.0.0.1", ports[0]) as a, \
+             MerkleKVClient("127.0.0.1", ports[1]) as b:
+            a.set("mq:x", "from-a")
+            b.set("mq:y", "from-b")
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if b.get("mq:x") == "from-a" and a.get("mq:y") == "from-b":
+                    break
+                time.sleep(0.1)
+            assert b.get("mq:x") == "from-a"
+            assert a.get("mq:y") == "from-b"
+            a.set("mq:del", "gone")
+            deadline = time.time() + 15
+            while time.time() < deadline and b.get("mq:del") != "gone":
+                time.sleep(0.1)
+            assert b.get("mq:del") == "gone"  # SET replicated before DEL
+            a.delete("mq:del")
+            deadline = time.time() + 15
+            while time.time() < deadline and b.get("mq:del") is not None:
+                time.sleep(0.1)
+            assert b.get("mq:del") is None
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
