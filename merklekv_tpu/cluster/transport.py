@@ -21,6 +21,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Optional, Protocol
 
 __all__ = ["Transport", "InProcessBus", "TcpBroker", "TcpTransport"]
@@ -193,6 +194,14 @@ class TcpBroker:
     def close(self) -> None:
         self._closed = True
         try:
+            # shutdown BEFORE close: the accept thread is blocked inside
+            # accept() and holds the kernel socket alive — a bare close()
+            # leaves the port in LISTEN until that syscall returns (i.e.
+            # forever), so a restarted broker can never rebind it.
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._listener.close()
         except OSError:
             pass
@@ -201,25 +210,95 @@ class TcpBroker:
             self._clients.clear()
         for s, _lk in entries:
             try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 s.close()
             except OSError:
                 pass
 
 
 class TcpTransport:
-    """Client for TcpBroker implementing the Transport interface."""
+    """Client for TcpBroker implementing the Transport interface.
+
+    Self-healing: when the broker link drops (broker restart, network
+    blip), the reader reconnects with capped exponential backoff and the
+    fabric resumes — the reference's rumqttc event loop does the same
+    (/root/reference/src/replication.rs:148-166). Events published while
+    down are dropped (QoS-0 by design; anti-entropy repairs), and
+    ``reconnects`` counts the healed outages for observability."""
+
+    # Backoff: first retry almost immediately (broker restarts are usually
+    # fast), cap well below the anti-entropy interval so the fabric heals
+    # before the repair loop has to.
+    _BACKOFF_FIRST = 0.2
+    _BACKOFF_MAX = 5.0
 
     def __init__(self, host: str, port: int, timeout: float = 5.0) -> None:
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._host, self._port, self._timeout = host, port, timeout
+        self._sock = self._connect()
         self._subs: list[tuple[str, Callback]] = []
         self._mu = threading.Lock()
         self._send_mu = threading.Lock()
         self._closed = False
         self.callback_errors = 0
+        self.reconnects = 0
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        if sock.getsockname() == sock.getpeername():
+            # TCP self-connect: dialing a broker port in the ephemeral range
+            # while it is down can simultaneous-connect to ITSELF — the
+            # socket then squats the port and blocks the broker's rebind.
+            sock.close()
+            raise ConnectionRefusedError("self-connect (broker down)")
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Kernel keepalive probes: a subscriber-only node never writes, so
+        # without these a silent partition (power loss, NAT drop — no RST)
+        # blocks recv forever and reconnect never triggers. ~15s idle +
+        # 3 x 5s probes bounds deafness to ~30s.
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 15)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 5)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
+        except (OSError, AttributeError):
+            pass  # non-Linux: base SO_KEEPALIVE still applies
+        return sock
+
+    def _reconnect(self) -> bool:
+        """Re-dial until the broker answers or close() is called."""
+        delay = self._BACKOFF_FIRST
+        while not self._closed:
+            time.sleep(delay)
+            if self._closed:
+                return False
+            try:
+                sock = self._connect()
+            except OSError:
+                delay = min(delay * 2, self._BACKOFF_MAX)
+                continue
+            with self._send_mu:
+                if self._closed:
+                    # close() ran while we were dialing: the old socket is
+                    # already shut down; do not leak the fresh one.
+                    sock.close()
+                    return False
+                old = self._sock
+                self._sock = sock
+            try:
+                old.close()
+            except OSError:
+                pass
+            self.reconnects += 1
+            return True
+        return False
 
     def publish(self, topic: str, payload: bytes) -> None:
         with self._send_mu:
@@ -248,7 +327,9 @@ class TcpTransport:
         while not self._closed:
             frame = _read_frame(self._sock)
             if frame is None:
-                return
+                if self._closed or not self._reconnect():
+                    return
+                continue
             topic, payload = frame
             with self._mu:
                 subs = list(self._subs)

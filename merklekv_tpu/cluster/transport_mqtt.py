@@ -118,6 +118,11 @@ def _topic_matches(filt: str, topic: str) -> bool:
 class MqttTransport:
     """Transport (transport.py Protocol) over MQTT 3.1.1, QoS-0."""
 
+    # Same backoff policy as TcpTransport (transport.py): first retry
+    # almost immediately, cap below the anti-entropy interval.
+    _BACKOFF_FIRST = 0.2
+    _BACKOFF_MAX = 5.0
+
     def __init__(
         self,
         host: str,
@@ -128,39 +133,111 @@ class MqttTransport:
         keepalive: int = 30,
         timeout: float = 10.0,
     ) -> None:
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._host, self._port, self._timeout = host, port, timeout
+        self._client_id = client_id or f"mkv-{id(self):x}"
+        self._username, self._password = username, password
         self._subs: list[tuple[str, Callback]] = []
         self._mu = threading.Lock()
         self._send_mu = threading.Lock()
         self._closed = False
         self._keepalive = keepalive
         self.callback_errors = 0
-
-        client_id = client_id or f"mkv-{id(self):x}"
-        flags = 0x02  # clean session
-        payload = _utf8(client_id)
-        if username:
-            flags |= 0x80
-            payload += _utf8(username)
-            if password:
-                flags |= 0x40
-                payload += _utf8(password)
-        var = _utf8("MQTT") + bytes([4, flags]) + struct.pack(">H", keepalive)
-        self._send_packet(_CONNECT, var + payload)
-
-        pkt = _read_packet(self._sock)
-        if pkt is None or (pkt[0] & 0xF0) != _CONNACK:
-            raise ConnectionError("MQTT: no CONNACK")
-        if len(pkt[1]) < 2 or pkt[1][1] != 0:
-            raise ConnectionError(f"MQTT: connection refused rc={pkt[1][1]}")
-        self._sock.settimeout(None)
-
+        self.reconnects = 0
         self._packet_id = 0
+
+        self._sock = self._dial_and_handshake()
+
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         self._pinger = threading.Thread(target=self._ping_loop, daemon=True)
         self._pinger.start()
+
+    def _dial_and_handshake(self) -> socket.socket:
+        """TCP connect + CONNECT/CONNACK. Raises on refusal."""
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        if sock.getsockname() == sock.getpeername():
+            # TCP self-connect while the broker is down (see
+            # transport.TcpTransport._connect) — fail the attempt.
+            sock.close()
+            raise ConnectionRefusedError("self-connect (broker down)")
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        flags = 0x02  # clean session
+        payload = _utf8(self._client_id)
+        if self._username:
+            flags |= 0x80
+            payload += _utf8(self._username)
+            if self._password:
+                flags |= 0x40
+                payload += _utf8(self._password)
+        var = (
+            _utf8("MQTT") + bytes([4, flags])
+            + struct.pack(">H", self._keepalive)
+        )
+        body = var + payload
+        sock.sendall(bytes([_CONNECT]) + _encode_varlen(len(body)) + body)
+        pkt = _read_packet(sock)
+        if pkt is None or (pkt[0] & 0xF0) != _CONNACK:
+            sock.close()
+            raise ConnectionError("MQTT: no CONNACK")
+        if len(pkt[1]) < 2 or pkt[1][1] != 0:
+            rc = pkt[1][1] if len(pkt[1]) >= 2 else -1
+            sock.close()
+            raise ConnectionError(f"MQTT: connection refused rc={rc}")
+        # Read deadline = 2x keepalive: the pinger elicits a PINGRESP every
+        # keepalive/2, so a healthy link always has inbound traffic well
+        # inside the window. A silent partition (no RST — power loss, NAT
+        # drop) times the recv out instead of blocking forever, and the
+        # read loop treats that as a dead link and reconnects.
+        sock.settimeout(max(2.0 * self._keepalive, 1.0))
+        return sock
+
+    def _reconnect(self) -> bool:
+        """Re-dial + handshake + re-SUBSCRIBE every live subscription —
+        clean-session brokers forget filters across connections, so a
+        reconnect without resubscribe would heal the link but stay deaf
+        (the reference's rumqttc resubscribes the same way)."""
+        delay = self._BACKOFF_FIRST
+        while not self._closed:
+            time.sleep(delay)
+            if self._closed:
+                return False
+            try:
+                sock = self._dial_and_handshake()
+            except (OSError, ConnectionError):
+                delay = min(delay * 2, self._BACKOFF_MAX)
+                continue
+            with self._send_mu:
+                if self._closed:
+                    # close() ran while we were dialing: do not leak the
+                    # fresh, fully CONNECTed session.
+                    sock.close()
+                    return False
+                old = self._sock
+                self._sock = sock
+            try:
+                old.close()
+            except OSError:
+                pass
+            with self._mu:
+                prefixes = [p for p, _ in self._subs]
+            for prefix in prefixes:
+                self._send_subscribe(prefix)
+            self.reconnects += 1
+            return True
+        return False
+
+    def _send_subscribe(self, topic_prefix: str) -> None:
+        with self._mu:
+            self._packet_id = self._packet_id % 0xFFFF + 1
+            pid = self._packet_id
+        body = struct.pack(">H", pid) + _utf8(topic_prefix + "/#") + b"\x00"
+        with self._send_mu:
+            try:
+                self._send_packet_locked(_SUBSCRIBE, body)
+            except OSError:
+                pass  # the read loop notices the dead link and reconnects
 
     # -- Transport interface --------------------------------------------------
     def publish(self, topic: str, payload: bytes) -> None:
@@ -174,17 +251,10 @@ class MqttTransport:
     def subscribe(self, topic_prefix: str, callback: Callback) -> None:
         with self._mu:
             self._subs.append((topic_prefix, callback))
-            self._packet_id = self._packet_id % 0xFFFF + 1
-            pid = self._packet_id
         # '#' matches the prefix level itself and everything below it —
         # the "{prefix}/events/#" shape the reference subscribes
         # (replication.rs:142-143).
-        body = struct.pack(">H", pid) + _utf8(topic_prefix + "/#") + b"\x00"
-        with self._send_mu:
-            try:
-                self._send_packet_locked(_SUBSCRIBE, body)
-            except OSError:
-                pass  # reconnect logic is the caller's policy
+        self._send_subscribe(topic_prefix)
 
     def unsubscribe(self, callback: Callback) -> None:
         with self._mu:
@@ -221,13 +291,17 @@ class MqttTransport:
                 try:
                     self._send_packet_locked(_PINGREQ, b"")
                 except OSError:
-                    return
+                    # Dead link: the read loop owns reconnection; keep the
+                    # pinger alive so keepalive resumes on the new socket.
+                    continue
 
     def _read_loop(self) -> None:
         while not self._closed:
             pkt = _read_packet(self._sock)
             if pkt is None:
-                return
+                if self._closed or not self._reconnect():
+                    return
+                continue
             header, body = pkt
             ptype = header & 0xF0
             if ptype != _PUBLISH:
@@ -346,7 +420,9 @@ class MqttBroker:
                     self._send(cid, bytes([_PUBACK, 2]) + pid_bytes)
                 else:  # QoS 2: PUBREC now, PUBCOMP on the sender's PUBREL
                     self._send(cid, bytes([_PUBREC, 2]) + pid_bytes)
-            out_body = body[:2] + body[2 : 2 + tlen] + body[payload_off:]
+            out_body = (
+                body if not qos else body[: 2 + tlen] + body[payload_off:]
+            )
             frame = (
                 bytes([_PUBLISH]) + _encode_varlen(len(out_body)) + out_body
             )
@@ -387,6 +463,12 @@ class MqttBroker:
     def close(self) -> None:
         self._closed = True
         try:
+            # shutdown BEFORE close — see TcpBroker.close: the blocked
+            # accept() otherwise keeps the port in LISTEN forever.
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._listener.close()
         except OSError:
             pass
@@ -394,6 +476,10 @@ class MqttBroker:
             entries = list(self._clients.values())
             self._clients.clear()
         for s, _lk, _f in entries:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:

@@ -420,3 +420,50 @@ def test_convergence_under_frame_loss(broker):
             nd.stop()
             sv.close()
             en.close()
+
+
+def test_framed_transport_reconnects_after_broker_restart():
+    """Broker restart heals the fabric without node restarts: the transport
+    re-dials with backoff and events flow again (the reference's rumqttc
+    behavior, replication.rs:148-166)."""
+    broker = TcpBroker()
+    port = broker.port
+    t_pub = TcpTransport(broker.host, port)
+    t_sub = TcpTransport(broker.host, port)
+    got = []
+    try:
+        t_sub.subscribe("rc/events", lambda topic, p: got.append(p))
+        time.sleep(0.05)
+        t_pub.publish("rc/events", b"before")
+        deadline = time.time() + 5
+        while time.time() < deadline and got != [b"before"]:
+            time.sleep(0.01)
+        assert got == [b"before"]
+
+        broker.close()
+        # Same port: restarted broker, new process in production terms.
+        deadline = time.time() + 10
+        broker = None
+        while time.time() < deadline and broker is None:
+            try:
+                broker = TcpBroker(port=port)
+            except OSError:
+                time.sleep(0.1)  # TIME_WAIT on the listener
+        assert broker is not None, "broker could not rebind its port"
+        deadline = time.time() + 15
+        while time.time() < deadline and (
+            t_pub.reconnects < 1 or t_sub.reconnects < 1
+        ):
+            time.sleep(0.05)
+        assert t_pub.reconnects >= 1 and t_sub.reconnects >= 1
+
+        deadline = time.time() + 10
+        while time.time() < deadline and b"after" not in got:
+            t_pub.publish("rc/events", b"after")
+            time.sleep(0.1)
+        assert b"after" in got
+    finally:
+        t_pub.close()
+        t_sub.close()
+        if broker is not None:
+            broker.close()

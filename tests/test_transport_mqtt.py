@@ -332,3 +332,46 @@ client_id = "mq-node-{i}"
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_mqtt_transport_reconnects_after_broker_restart():
+    """Broker restart heals the MQTT fabric: the transport re-dials,
+    re-handshakes, and RE-SUBSCRIBES (clean-session brokers forget filters)
+    — rumqttc behavior (/root/reference/src/replication.rs:148-166)."""
+    broker = MqttBroker()
+    port = broker.port
+    t_pub = MqttTransport(broker.host, port, client_id="rc-pub")
+    t_sub = MqttTransport(broker.host, port, client_id="rc-sub")
+    got = []
+    try:
+        t_sub.subscribe("mrc/events", lambda topic, p: got.append(p))
+        time.sleep(0.05)
+        t_pub.publish("mrc/events", b"before")
+        assert wait_for(lambda: got == [b"before"])
+
+        broker.close()
+        deadline = time.time() + 10
+        broker = None
+        while time.time() < deadline and broker is None:
+            try:
+                broker = MqttBroker(port=port)
+            except OSError:
+                time.sleep(0.1)
+        assert broker is not None, "broker could not rebind its port"
+
+        assert wait_for(
+            lambda: t_pub.reconnects >= 1 and t_sub.reconnects >= 1,
+            timeout=15,
+        ), (t_pub.reconnects, t_sub.reconnects)
+
+        # The resubscribed filter must actually deliver.
+        deadline = time.time() + 10
+        while time.time() < deadline and b"after" not in got:
+            t_pub.publish("mrc/events", b"after")
+            time.sleep(0.1)
+        assert b"after" in got
+    finally:
+        t_pub.close()
+        t_sub.close()
+        if broker is not None:
+            broker.close()
