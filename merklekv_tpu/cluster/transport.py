@@ -219,6 +219,55 @@ class TcpBroker:
                 pass
 
 
+def _heal_link(t, dial, on_connected=None) -> bool:
+    """Shared reconnect engine for broker-client transports.
+
+    ``t`` exposes ``_closed``, ``_send_mu``, ``_sock``, ``reconnects``, and
+    the ``_BACKOFF_FIRST``/``_BACKOFF_MAX`` policy; ``dial()`` returns a
+    fresh connected socket or raises OSError; ``on_connected`` runs after
+    the swap (e.g. MQTT resubscribe). Returns False when ``close()`` ended
+    the transport.
+    """
+    delay = t._BACKOFF_FIRST
+    while not t._closed:
+        time.sleep(delay)
+        if t._closed:
+            return False
+        try:
+            sock = dial()
+        except OSError:
+            delay = min(delay * 2, t._BACKOFF_MAX)
+            continue
+        # Unblock any publisher stuck in sendall() on the dead socket
+        # BEFORE taking _send_mu: without a send timeout that sendall only
+        # errors at the kernel's retransmission limit (~15-30 min), and it
+        # HOLDS _send_mu — the swap would stall healing for that long.
+        try:
+            t._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        with t._send_mu:
+            if t._closed:
+                # close() ran while we were dialing: the old socket is
+                # already shut down; do not leak the fresh one.
+                sock.close()
+                return False
+            old = t._sock
+            t._sock = sock
+        try:
+            old.close()
+        except OSError:
+            pass
+        t.reconnects += 1
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        get_metrics().inc("transport.reconnects")
+        if on_connected is not None:
+            on_connected()
+        return True
+    return False
+
+
 class TcpTransport:
     """Client for TcpBroker implementing the Transport interface.
 
@@ -274,31 +323,7 @@ class TcpTransport:
 
     def _reconnect(self) -> bool:
         """Re-dial until the broker answers or close() is called."""
-        delay = self._BACKOFF_FIRST
-        while not self._closed:
-            time.sleep(delay)
-            if self._closed:
-                return False
-            try:
-                sock = self._connect()
-            except OSError:
-                delay = min(delay * 2, self._BACKOFF_MAX)
-                continue
-            with self._send_mu:
-                if self._closed:
-                    # close() ran while we were dialing: the old socket is
-                    # already shut down; do not leak the fresh one.
-                    sock.close()
-                    return False
-                old = self._sock
-                self._sock = sock
-            try:
-                old.close()
-            except OSError:
-                pass
-            self.reconnects += 1
-            return True
-        return False
+        return _heal_link(self, self._connect)
 
     def publish(self, topic: str, payload: bytes) -> None:
         with self._send_mu:

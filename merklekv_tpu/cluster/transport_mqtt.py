@@ -189,8 +189,11 @@ class MqttTransport:
         # keepalive/2, so a healthy link always has inbound traffic well
         # inside the window. A silent partition (no RST — power loss, NAT
         # drop) times the recv out instead of blocking forever, and the
-        # read loop treats that as a dead link and reconnects.
-        sock.settimeout(max(2.0 * self._keepalive, 1.0))
+        # read loop treats that as a dead link and reconnects. keepalive=0
+        # means keepalive DISABLED per spec 3.1.2.10 — no deadline then.
+        sock.settimeout(
+            max(2.0 * self._keepalive, 1.0) if self._keepalive else None
+        )
         return sock
 
     def _reconnect(self) -> bool:
@@ -198,35 +201,15 @@ class MqttTransport:
         clean-session brokers forget filters across connections, so a
         reconnect without resubscribe would heal the link but stay deaf
         (the reference's rumqttc resubscribes the same way)."""
-        delay = self._BACKOFF_FIRST
-        while not self._closed:
-            time.sleep(delay)
-            if self._closed:
-                return False
-            try:
-                sock = self._dial_and_handshake()
-            except (OSError, ConnectionError):
-                delay = min(delay * 2, self._BACKOFF_MAX)
-                continue
-            with self._send_mu:
-                if self._closed:
-                    # close() ran while we were dialing: do not leak the
-                    # fresh, fully CONNECTed session.
-                    sock.close()
-                    return False
-                old = self._sock
-                self._sock = sock
-            try:
-                old.close()
-            except OSError:
-                pass
-            with self._mu:
-                prefixes = [p for p, _ in self._subs]
-            for prefix in prefixes:
-                self._send_subscribe(prefix)
-            self.reconnects += 1
-            return True
-        return False
+        from merklekv_tpu.cluster.transport import _heal_link
+
+        return _heal_link(self, self._dial_and_handshake, self._resubscribe)
+
+    def _resubscribe(self) -> None:
+        with self._mu:
+            prefixes = [p for p, _ in self._subs]
+        for prefix in prefixes:
+            self._send_subscribe(prefix)
 
     def _send_subscribe(self, topic_prefix: str) -> None:
         with self._mu:
@@ -279,9 +262,23 @@ class MqttTransport:
             self._send_packet_locked(header, body)
 
     def _send_packet_locked(self, header: int, body: bytes) -> None:
-        self._sock.sendall(bytes([header]) + _encode_varlen(len(body)) + body)
+        try:
+            self._sock.sendall(
+                bytes([header]) + _encode_varlen(len(body)) + body
+            )
+        except OSError:
+            # A failed sendall may have written PART of the frame; the
+            # stream is misaligned and every later write would feed the
+            # broker garbage. Poison the socket so the read loop reconnects.
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise
 
     def _ping_loop(self) -> None:
+        if not self._keepalive:
+            return  # keepalive=0: disabled per spec
         interval = max(self._keepalive // 2, 1)
         while not self._closed:
             time.sleep(interval)

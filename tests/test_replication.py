@@ -456,6 +456,11 @@ def test_framed_transport_reconnects_after_broker_restart():
         ):
             time.sleep(0.05)
         assert t_pub.reconnects >= 1 and t_sub.reconnects >= 1
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        assert get_metrics().snapshot()["counters"].get(
+            "transport.reconnects", 0
+        ) >= 2
 
         deadline = time.time() + 10
         while time.time() < deadline and b"after" not in got:
