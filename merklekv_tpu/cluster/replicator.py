@@ -1,9 +1,12 @@
 """Replicator: native write events out, remote events applied in.
 
 Reference analog: /root/reference/src/replication.rs — publish every
-successful local write as a ChangeEvent on "{prefix}/events" (QoS-1 there,
-QoS-0 here with anti-entropy as the repair path), subscribe and apply remote
-events with loop prevention (src), idempotency (op_id), and per-key LWW.
+successful local write as a ChangeEvent on "{prefix}/events" (QoS-1 there;
+QoS-0 here, upgraded by the transports' bounded outbox: events published
+during a detected broker outage are buffered and flushed after the link
+heals, so only the narrow undetected-death window is lossy — and
+anti-entropy repairs that residue), subscribe and apply remote events with
+loop prevention (src), idempotency (op_id), and per-key LWW.
 
 Differences by design:
   - local writes are staged by the NATIVE server into an EventQueue

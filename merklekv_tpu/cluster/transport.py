@@ -22,6 +22,9 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
+
+from merklekv_tpu.utils.tracing import get_metrics
 from typing import Callable, Optional, Protocol
 
 __all__ = ["Transport", "InProcessBus", "TcpBroker", "TcpTransport"]
@@ -219,6 +222,76 @@ class TcpBroker:
                 pass
 
 
+# Events published while the broker link is down wait here (per-transport
+# bounded FIFO) and flush after healing — without this every write during
+# an outage is silently gone and only anti-entropy ever repairs it. The
+# bound keeps a long outage from eating the heap; overflow drops the
+# OLDEST event (LWW: newer state supersedes older) and counts the drop.
+OUTBOX_LIMIT = 8192
+
+
+def _enable_tcp_keepalive(sock: socket.socket) -> None:
+    """Kernel keepalive probes: a subscriber-only client never writes, so
+    without these a silent partition (power loss, NAT drop — no RST) blocks
+    recv forever and reconnect never triggers. ~15s idle + 3 x 5s probes
+    bounds deafness to ~30s."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 15)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 5)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
+    except (OSError, AttributeError):
+        pass  # non-Linux: base SO_KEEPALIVE still applies
+
+
+def _enqueue_outbox(t, topic: str, payload: bytes) -> None:
+    with t._outbox_mu:
+        if len(t._outbox) >= OUTBOX_LIMIT:
+            t._outbox.popleft()
+            t.outbox_dropped += 1
+            get_metrics().inc("transport.outbox_dropped")
+        t._outbox.append((topic, payload))
+
+
+def _publish_or_queue(t, topic: str, payload: bytes) -> None:
+    """Transport publish body: enqueue during a KNOWN outage (the reader
+    flagged the link down), otherwise attempt the wire and enqueue on
+    failure. An in-flight send that the kernel buffered just before an
+    undetected death can still be lost — bounding that window needs
+    broker acks, which is the one QoS-1 piece deliberately not taken on
+    (anti-entropy repairs the residue; see the replicator docstring)."""
+    if t.link_down:
+        _enqueue_outbox(t, topic, payload)
+        # Enqueue/heal race: if the heal finished (and drained) between the
+        # flag read and the append, nothing would ever flush this event —
+        # drain opportunistically now that the link is back.
+        if not t.link_down:
+            _drain_outbox(t)
+        return
+    try:
+        t._wire_send(topic, payload)
+    except OSError:
+        _enqueue_outbox(t, topic, payload)
+        if not t.link_down:
+            _drain_outbox(t)
+
+
+def _drain_outbox(t) -> None:
+    """Flush queued events through the healed link, FIFO. Stops (and
+    re-queues the event in flight) if the link dies again mid-drain."""
+    while True:
+        with t._outbox_mu:
+            if not t._outbox:
+                return
+            topic, payload = t._outbox.popleft()
+        try:
+            t._wire_send(topic, payload)
+        except OSError:
+            with t._outbox_mu:
+                t._outbox.appendleft((topic, payload))
+            return
+
+
 def _heal_link(t, dial, on_connected=None) -> bool:
     """Shared reconnect engine for broker-client transports.
 
@@ -228,6 +301,7 @@ def _heal_link(t, dial, on_connected=None) -> bool:
     the swap (e.g. MQTT resubscribe). Returns False when ``close()`` ended
     the transport.
     """
+    t.link_down = True
     delay = t._BACKOFF_FIRST
     while not t._closed:
         time.sleep(delay)
@@ -258,9 +332,8 @@ def _heal_link(t, dial, on_connected=None) -> bool:
             old.close()
         except OSError:
             pass
+        t.link_down = False
         t.reconnects += 1
-        from merklekv_tpu.utils.tracing import get_metrics
-
         get_metrics().inc("transport.reconnects")
         if on_connected is not None:
             on_connected()
@@ -274,9 +347,11 @@ class TcpTransport:
     Self-healing: when the broker link drops (broker restart, network
     blip), the reader reconnects with capped exponential backoff and the
     fabric resumes — the reference's rumqttc event loop does the same
-    (/root/reference/src/replication.rs:148-166). Events published while
-    down are dropped (QoS-0 by design; anti-entropy repairs), and
-    ``reconnects`` counts the healed outages for observability."""
+    (/root/reference/src/replication.rs:148-166). Events published during
+    a detected outage wait in a bounded outbox and flush after the heal
+    (only the narrow undetected-death window is lossy; anti-entropy
+    repairs that residue). ``reconnects`` / ``outbox_dropped`` count the
+    healed outages and overflow drops for observability."""
 
     # Backoff: first retry almost immediately (broker restarts are usually
     # fast), cap well below the anti-entropy interval so the fabric heals
@@ -293,6 +368,10 @@ class TcpTransport:
         self._closed = False
         self.callback_errors = 0
         self.reconnects = 0
+        self._outbox: deque[tuple[str, bytes]] = deque()
+        self._outbox_mu = threading.Lock()
+        self.outbox_dropped = 0
+        self.link_down = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -308,29 +387,19 @@ class TcpTransport:
             raise ConnectionRefusedError("self-connect (broker down)")
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # Kernel keepalive probes: a subscriber-only node never writes, so
-        # without these a silent partition (power loss, NAT drop — no RST)
-        # blocks recv forever and reconnect never triggers. ~15s idle +
-        # 3 x 5s probes bounds deafness to ~30s.
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-        try:
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 15)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 5)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
-        except (OSError, AttributeError):
-            pass  # non-Linux: base SO_KEEPALIVE still applies
+        _enable_tcp_keepalive(sock)
         return sock
 
     def _reconnect(self) -> bool:
         """Re-dial until the broker answers or close() is called."""
-        return _heal_link(self, self._connect)
+        return _heal_link(self, self._connect, lambda: _drain_outbox(self))
 
     def publish(self, topic: str, payload: bytes) -> None:
+        _publish_or_queue(self, topic, payload)
+
+    def _wire_send(self, topic: str, payload: bytes) -> None:
         with self._send_mu:
-            try:
-                self._sock.sendall(_frame(topic, payload))
-            except OSError:
-                pass  # QoS-0: drop on broken broker link
+            self._sock.sendall(_frame(topic, payload))
 
     def subscribe(self, topic_prefix: str, callback: Callback) -> None:
         with self._mu:

@@ -24,7 +24,15 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
+
+from merklekv_tpu.cluster.transport import (
+    _drain_outbox,
+    _enable_tcp_keepalive,
+    _heal_link,
+    _publish_or_queue,
+)
 
 __all__ = ["MqttTransport", "MqttBroker", "StubMqttBroker"]
 
@@ -143,6 +151,10 @@ class MqttTransport:
         self._keepalive = keepalive
         self.callback_errors = 0
         self.reconnects = 0
+        self._outbox = deque()
+        self._outbox_mu = threading.Lock()
+        self.outbox_dropped = 0
+        self.link_down = False
         self._packet_id = 0
 
         self._sock = self._dial_and_handshake()
@@ -163,6 +175,9 @@ class MqttTransport:
             sock.close()
             raise ConnectionRefusedError("self-connect (broker down)")
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Kernel-level liveness too: with keepalive=0 (app-level keepalive
+        # disabled per spec) this is the ONLY silent-partition detection.
+        _enable_tcp_keepalive(sock)
         flags = 0x02  # clean session
         payload = _utf8(self._client_id)
         if self._username:
@@ -201,15 +216,16 @@ class MqttTransport:
         clean-session brokers forget filters across connections, so a
         reconnect without resubscribe would heal the link but stay deaf
         (the reference's rumqttc resubscribes the same way)."""
-        from merklekv_tpu.cluster.transport import _heal_link
+        return _heal_link(self, self._dial_and_handshake, self._on_healed)
 
-        return _heal_link(self, self._dial_and_handshake, self._resubscribe)
-
-    def _resubscribe(self) -> None:
+    def _on_healed(self) -> None:
+        # Resubscribe FIRST (a clean-session broker forgot the filters),
+        # then flush events queued during the outage.
         with self._mu:
             prefixes = [p for p, _ in self._subs]
         for prefix in prefixes:
             self._send_subscribe(prefix)
+        _drain_outbox(self)
 
     def _send_subscribe(self, topic_prefix: str) -> None:
         with self._mu:
@@ -224,12 +240,12 @@ class MqttTransport:
 
     # -- Transport interface --------------------------------------------------
     def publish(self, topic: str, payload: bytes) -> None:
+        _publish_or_queue(self, topic, payload)
+
+    def _wire_send(self, topic: str, payload: bytes) -> None:
         body = _utf8(topic) + payload  # QoS-0: no packet id
         with self._send_mu:
-            try:
-                self._send_packet_locked(_PUBLISH, body)
-            except OSError:
-                pass  # QoS-0: drop on broken broker link
+            self._send_packet_locked(_PUBLISH, body)
 
     def subscribe(self, topic_prefix: str, callback: Callback) -> None:
         with self._mu:

@@ -375,3 +375,42 @@ def test_mqtt_transport_reconnects_after_broker_restart():
         t_sub.close()
         if broker is not None:
             broker.close()
+
+
+def test_mqtt_outbox_flushes_after_heal():
+    """Events published during a DETECTED broker outage are buffered and
+    delivered (after resubscribe) once the link heals."""
+    broker = MqttBroker()
+    port = broker.port
+    t_pub = MqttTransport(broker.host, port, client_id="ob-pub")
+    t_sub = MqttTransport(broker.host, port, client_id="ob-sub")
+    # The publisher's post-heal drain races the subscriber's resubscribe
+    # (QoS-0 has no cross-client ordering); stagger the publisher's first
+    # retry so the subscriber deterministically heals first.
+    t_pub._BACKOFF_FIRST = 1.5
+    got = []
+    try:
+        t_sub.subscribe("mob/events", lambda topic, p: got.append(p))
+        time.sleep(0.05)
+        broker.close()
+        assert wait_for(lambda: t_pub.link_down and t_sub.link_down), (
+            t_pub.link_down, t_sub.link_down
+        )
+        for i in range(5):
+            t_pub.publish("mob/events", b"d-%d" % i)
+        assert got == []
+        deadline = time.time() + 10
+        broker = None
+        while time.time() < deadline and broker is None:
+            try:
+                broker = MqttBroker(port=port)
+            except OSError:
+                time.sleep(0.1)
+        assert broker is not None, "broker could not rebind its port"
+        assert wait_for(lambda: len(got) >= 5, timeout=15), got
+        assert got == [b"d-%d" % i for i in range(5)]
+    finally:
+        t_pub.close()
+        t_sub.close()
+        if broker is not None:
+            broker.close()
