@@ -521,3 +521,28 @@ def test_framed_outbox_flushes_after_heal():
         t_sub.close()
         if broker is not None:
             broker.close()
+
+
+def test_outbox_overflow_drops_oldest_and_counts():
+    """The outage buffer is bounded: overflow drops the OLDEST event (LWW:
+    newer state supersedes older) and counts the drop."""
+    from merklekv_tpu.cluster import transport as tmod
+
+    broker = TcpBroker()
+    t = TcpTransport(broker.host, broker.port)
+    try:
+        t.link_down = True  # force the enqueue path; no wire traffic
+        n_extra = 7
+        for i in range(tmod.OUTBOX_LIMIT + n_extra):
+            t.publish("of/events", b"e-%d" % i)
+        assert len(t._outbox) == tmod.OUTBOX_LIMIT
+        assert t.outbox_dropped == n_extra
+        # Oldest dropped: the queue starts at e-<n_extra>.
+        assert t._outbox[0] == ("of/events", b"e-%d" % n_extra)
+        assert t._outbox[-1] == (
+            "of/events", b"e-%d" % (tmod.OUTBOX_LIMIT + n_extra - 1)
+        )
+    finally:
+        t.link_down = False
+        t.close()
+        broker.close()
