@@ -23,7 +23,9 @@ The remaining BASELINE.json configs print one JSON line each on STDERR
     updates against a 1M-key device tree (config 4's 100K writes/s target);
   - diff64_keys_per_s: 64-replica divergence program (config 5's scale
     axis, reduced n on one chip; the virtual-mesh dryrun covers the
-    multi-device program).
+    multi-device program);
+  - op_latency_us: client-observed SET/GET p50/p99 against the embedded
+    native server over localhost TCP.
 
 Off-TPU the sizes shrink to smoke-test values so the script stays runnable
 in CI; the driver's real run happens on the chip.
@@ -248,6 +250,51 @@ def bench_incremental_rehash(n_tree: int, batch: int, batches: int) -> dict:
     }
 
 
+def bench_op_latency(n_ops: int) -> dict:
+    """Client-observed op latency: SET/GET p50/p99 over localhost TCP
+    against the embedded native server (the reference's test_benchmark.py
+    measures the same client-side round trip; its README claims low-latency
+    ops as a headline). One connection, sequential ops — per-op wire+parse+
+    engine cost, not concurrency throughput (test_benchmark.py floors cover
+    that)."""
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    try:
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            set_ns, get_ns = [], []
+            for i in range(n_ops):
+                t0 = time.perf_counter_ns()
+                c.set(f"lat:{i:07d}", f"v-{i}")
+                set_ns.append(time.perf_counter_ns() - t0)
+            for i in range(n_ops):
+                t0 = time.perf_counter_ns()
+                c.get(f"lat:{i:07d}")
+                get_ns.append(time.perf_counter_ns() - t0)
+        set_ns.sort()
+        get_ns.sort()
+
+        def pct(v, p):
+            return round(v[min(int(p * (len(v) - 1)), len(v) - 1)] / 1e3, 1)
+
+        return {
+            "metric": "op_latency_us",
+            "value": pct(get_ns, 0.5),
+            "unit": "us (GET p50)",
+            "ops": n_ops,
+            "set_p50_us": pct(set_ns, 0.5),
+            "set_p99_us": pct(set_ns, 0.99),
+            "get_p50_us": pct(get_ns, 0.5),
+            "get_p99_us": pct(get_ns, 0.99),
+        }
+    finally:
+        srv.close()
+        eng.close()
+
+
 def bench_diff64(n: int, reps: int) -> dict:
     """BASELINE config 5 (single-chip proxy): 64-replica divergence program
     at reduced n. The multi-device variant is exercised by dryrun_multichip
@@ -332,6 +379,10 @@ def main() -> None:
         )
     except Exception as e:
         print(f"# diff64 bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(bench_op_latency(n_ops=10_000 if on_tpu else 1_000))
+    except Exception as e:
+        print(f"# op_latency bench failed: {e!r}", file=sys.stderr)
 
     for cfg in configs:
         print(json.dumps(cfg), file=sys.stderr)
