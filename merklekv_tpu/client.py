@@ -296,6 +296,16 @@ class MerkleKVClient:
             raise ProtocolError(f"unexpected response: {resp}")
         return self._read_kv_block()
 
+    def metrics(self) -> dict[str, str]:
+        """Control-plane counter snapshot (extension verb): transport
+        reconnects/outbox drops, anti-entropy loop counters — the
+        Python-layer numbers STATS (engine/server scope) cannot see.
+        Empty on a bare node without a cluster plane."""
+        resp = _parse_simple(self._request("METRICS"))
+        if resp != "METRICS":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_kv_block()
+
     def _read_kv_block(self) -> dict[str, str]:
         # Stats/info blocks are `name:value` lines closed by an END
         # terminator (same shape as CLIENT LIST). Servers that predate the

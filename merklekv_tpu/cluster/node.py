@@ -171,6 +171,32 @@ class ClusterNode:
     def health(self):
         return self._health
 
+    def _metrics_wire(self) -> str:
+        """METRICS wire payload: the control plane's counter snapshot —
+        transport reconnects/outbox drops, anti-entropy loop counters, span
+        counts — as ``name:value`` lines. The complement of STATS, which
+        covers the native engine/server scope only."""
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        lines = []
+        snap = get_metrics().snapshot()
+        for name in sorted(snap["counters"]):
+            lines.append(f"{name}:{snap['counters'][name]}")
+        # Span aggregates (integers only — the parsers treat values as
+        # numeric text): count and total milliseconds per span name.
+        for name in sorted(snap["spans"]):
+            sp = snap["spans"][name]
+            lines.append(f"span.{name}.count:{sp['count']}")
+            lines.append(f"span.{name}.total_ms:{int(sp['total_s'] * 1e3)}")
+        t = self._transport
+        if t is not None:
+            for attr in ("reconnects", "outbox_dropped", "callback_errors"):
+                v = getattr(t, attr, None)
+                if v is not None:
+                    lines.append(f"transport.{attr}_live:{v}")
+        body = "".join(f"{ln}\r\n" for ln in lines)
+        return f"METRICS\r\n{body}END\r\n"
+
     # -- cluster command callback ---------------------------------------------
     def _on_cluster_command(self, line: str) -> Optional[str]:
         parts = line.split()
@@ -178,6 +204,8 @@ class ClusterNode:
             if self._health is None:
                 return None  # native default: empty table
             return self._health.wire_table()
+        if parts[0] == "METRICS":
+            return self._metrics_wire()
         if parts[0] == "HASH":
             # Whole-keyspace root served from the device-resident
             # incremental tree; empty answer falls back to the native path.

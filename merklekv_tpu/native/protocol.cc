@@ -179,6 +179,7 @@ ParseResult parse_command(const std::string& line) {
     if (u == "HASH") { c.verb = Verb::Hash; return ok(std::move(c)); }
     if (u == "LEAFHASHES") { c.verb = Verb::LeafHashes; return ok(std::move(c)); }
     if (u == "PEERS") { c.verb = Verb::Peers; return ok(std::move(c)); }
+    if (u == "METRICS") { c.verb = Verb::Metrics; return ok(std::move(c)); }
     if (u == "CLIENT") { c.verb = Verb::ClientList; return ok(std::move(c)); }
     if (u == "PING") { c.verb = Verb::Ping; return ok(std::move(c)); }
     if (u == "SHUTDOWN") { c.verb = Verb::Shutdown; return ok(std::move(c)); }

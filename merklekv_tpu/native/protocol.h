@@ -24,6 +24,7 @@ enum class Verb {
   // Extension (like LEAFHASHES): per-peer health table from the cluster
   // control plane's failure detector.
   Peers,
+  Metrics,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };

@@ -282,6 +282,21 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       }
       return "PEERS 0\r\nEND\r\n";
     }
+    case Verb::Metrics: {
+      // Control-plane counter snapshot (extension verb): transport
+      // reconnects/outbox drops, anti-entropy loop stats, span counters —
+      // the Python-layer numbers STATS (engine/server scope) cannot see.
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp = cb("METRICS");
+        if (!resp.empty()) return resp;
+      }
+      return "METRICS\r\nEND\r\n";
+    }
     case Verb::Sync:
     case Verb::Replicate: {
       ClusterCallback cb;

@@ -88,6 +88,7 @@ struct ServerStats {
       case Verb::ClientList: management_commands++; break;
       case Verb::Memory: memory_commands++; break;
       case Verb::Peers: management_commands++; break;
+      case Verb::Metrics: management_commands++; break;
       case Verb::Sync: sync_commands++; break;
       case Verb::Hash:
       case Verb::LeafHashes: hash_commands++; break;

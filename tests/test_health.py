@@ -143,3 +143,38 @@ def test_sync_loop_skips_confirmed_down_peers(server):
         mgr.stop()
         psrv.close()
         peng.close()
+
+
+def test_metrics_verb_without_cluster_plane(server):
+    _, srv = server
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        assert c.metrics() == {}  # native default: empty block
+
+
+def test_metrics_verb_serves_control_plane_counters(server):
+    eng, srv = server
+    from merklekv_tpu.utils.tracing import get_metrics
+
+    cfg = Config()
+    node = ClusterNode(cfg, eng, srv)
+    node.start()
+    try:
+        # Delta-based: the registry is process-global, so an absolute value
+        # would break under reruns in one process.
+        before = int(
+            get_metrics().snapshot()["counters"].get("test_metrics.sentinel", 0)
+        )
+        get_metrics().inc("test_metrics.sentinel", 3)
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            snap = c.metrics()
+        assert snap.get("test_metrics.sentinel") == str(before + 3)
+        # Counters are numeric text across the board.
+        assert all(v.lstrip("-").isdigit() for v in snap.values()), snap
+        # Span aggregates ride along (any span recorded by the control
+        # plane shows as .count/.total_ms pairs — may be absent if no span
+        # has run yet in this process).
+        for k in snap:
+            if k.startswith("span."):
+                assert k.endswith((".count", ".total_ms")), k
+    finally:
+        node.stop()
