@@ -17,6 +17,7 @@
 #include <unistd.h>
 
 #include <cstdint>
+#include <map>
 #include <optional>
 #include <stdexcept>
 #include <string>
@@ -119,6 +120,14 @@ class Client {
 
   void flushdb() { expect(request("FLUSHDB"), "OK"); }
 
+  // ---- observability: VERB + name:value lines + END ----
+  std::map<std::string, std::string> stats() { return kv_block("STATS"); }
+
+  // Control-plane counter snapshot (METRICS extension verb): transport
+  // reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+  // node without a cluster plane.
+  std::map<std::string, std::string> metrics() { return kv_block("METRICS"); }
+
   // ---- cluster ----
   void sync_with(const std::string& host, uint16_t port) {
     expect(request("SYNC " + host + " " + std::to_string(port)), "OK");
@@ -144,6 +153,17 @@ class Client {
   }
 
  private:
+  std::map<std::string, std::string> kv_block(const std::string& verb) {
+    std::string first = request(verb);
+    if (first != verb) throw ProtocolError("unexpected " + verb + ": " + first);
+    std::map<std::string, std::string> out;
+    for (std::string line = read_line(); line != "END"; line = read_line()) {
+      auto c = line.find(':');
+      if (c != std::string::npos) out[line.substr(0, c)] = line.substr(c + 1);
+    }
+    return out;
+  }
+
   void connect_() {
     struct addrinfo hints {};
     hints.ai_family = AF_INET;

@@ -79,6 +79,7 @@ internal static class ClientSelfTest
 
             Check(c.HealthCheck(), "health check");
             Check(c.Stats().ContainsKey("total_commands"), "stats has total_commands");
+            Check(c.Metrics() != null, "metrics round-trips");
             Check(c.Version().Contains('.'), "version has a dot");
             Check(c.DbSize() >= 0, "dbsize");
 

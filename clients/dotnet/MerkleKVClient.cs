@@ -214,15 +214,24 @@ namespace MerkleKV
             }
         }
 
-        public Dictionary<string, string> Stats()
+        public Dictionary<string, string> Stats() => KvBlock("STATS");
+
+        /// <summary>
+        /// Control-plane counter snapshot (METRICS extension verb):
+        /// transport reconnects/outbox drops, anti-entropy loop stats.
+        /// Empty on a bare node without a cluster plane.
+        /// </summary>
+        public Dictionary<string, string> Metrics() => KvBlock("METRICS");
+
+        private Dictionary<string, string> KvBlock(string verb)
         {
             var outMap = new Dictionary<string, string>();
             lock (_lock)
             {
-                WriteLine("STATS");
+                WriteLine(verb);
                 var first = ReadLineRaiseError();
-                if (first != "STATS")
-                    throw new ServerException($"unexpected STATS response: {first}");
+                if (first != verb)
+                    throw new ServerException($"unexpected {verb} response: {first}");
                 while (true)
                 {
                     var line = ReadLine();

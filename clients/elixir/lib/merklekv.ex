@@ -202,11 +202,20 @@ defmodule MerkleKV do
     match?({:ok, _}, ping(c, "health"))
   end
 
-  def stats(c) do
-    with {:ok, "STATS"} <- command(c, "STATS") do
-      read_stats_lines(c, %{})
-    else
-      {:ok, other} -> {:error, {:protocol, "unexpected STATS response: #{other}"}}
+  def stats(c), do: kv_block(c, "STATS")
+
+  @doc """
+  Control-plane counter snapshot (METRICS extension verb): transport
+  reconnects/outbox drops, anti-entropy loop stats. Empty on a bare node
+  without a cluster plane.
+  """
+  def metrics(c), do: kv_block(c, "METRICS")
+
+  # Verb whose response is VERB + name:value lines + END.
+  defp kv_block(c, verb) do
+    case command(c, verb) do
+      {:ok, ^verb} -> read_stats_lines(c, %{})
+      {:ok, other} -> {:error, {:protocol, "unexpected #{verb} response: #{other}"}}
       err -> err
     end
   end

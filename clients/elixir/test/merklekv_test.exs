@@ -72,6 +72,7 @@ defmodule MerkleKVSelfTest do
     check(MerkleKV.health_check(c), "health check")
     {:ok, stats} = MerkleKV.stats(c)
     check(Map.has_key?(stats, "total_commands"), "stats has total_commands")
+    check(match?({:ok, %{}}, MerkleKV.metrics(c)), "metrics round-trips")
     {:ok, version} = MerkleKV.version(c)
     check(String.contains?(version, "."), "version has a dot")
     {:ok, n} = MerkleKV.dbsize(c)

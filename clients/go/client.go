@@ -411,20 +411,32 @@ func (c *Client) HealthCheck(ctx context.Context) error {
 
 // Stats returns the server's STATS counters as a map.
 func (c *Client) Stats(ctx context.Context) (map[string]string, error) {
+	return c.kvBlock(ctx, "STATS")
+}
+
+// Metrics returns the control-plane counter snapshot (METRICS extension
+// verb): transport reconnects/outbox drops, anti-entropy loop stats. The
+// map is empty on a bare node without a cluster plane.
+func (c *Client) Metrics(ctx context.Context) (map[string]string, error) {
+	return c.kvBlock(ctx, "METRICS")
+}
+
+// kvBlock runs a verb whose response is `VERB` + name:value lines + END.
+func (c *Client) kvBlock(ctx context.Context, verb string) (map[string]string, error) {
 	c.mu.Lock()
 	defer c.mu.Unlock()
 	if err := c.conn.SetDeadline(c.deadline(ctx)); err != nil {
 		return nil, err
 	}
-	if _, err := c.conn.Write([]byte("STATS\r\n")); err != nil {
+	if _, err := c.conn.Write([]byte(verb + "\r\n")); err != nil {
 		return nil, err
 	}
 	first, err := c.readLine()
 	if err != nil {
 		return nil, err
 	}
-	if first != "STATS" {
-		return nil, fmt.Errorf("merklekv: unexpected STATS response %q", first)
+	if first != verb {
+		return nil, fmt.Errorf("merklekv: unexpected %s response %q", verb, first)
 	}
 	lines, err := c.readUntilEnd()
 	if err != nil {

@@ -159,6 +159,10 @@ func TestStatsAndHealth(t *testing.T) {
 	if _, ok := stats["total_commands"]; !ok {
 		t.Fatalf("stats missing total_commands: %v", stats)
 	}
+	// METRICS: empty block on a bare node, but must round-trip cleanly.
+	if _, err := c.Metrics(ctx(t)); err != nil {
+		t.Fatalf("metrics: %v", err)
+	}
 	v, err := c.Version(ctx(t))
 	if err != nil || !strings.Contains(v, ".") {
 		t.Fatalf("version = %q, %v", v, err)

@@ -240,12 +240,26 @@ public final class MerkleKVClient implements AutoCloseable {
     }
 
     public Map<String, String> stats() throws IOException {
+        return kvBlock("STATS");
+    }
+
+    /**
+     * Control-plane counter snapshot (METRICS extension verb): transport
+     * reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+     * node without a cluster plane.
+     */
+    public Map<String, String> metrics() throws IOException {
+        return kvBlock("METRICS");
+    }
+
+    /** Verb whose response is {@code VERB} + name:value lines + END. */
+    private Map<String, String> kvBlock(String verb) throws IOException {
         Map<String, String> result = new HashMap<>();
         synchronized (lock) {
-            out.write("STATS\r\n".getBytes(StandardCharsets.UTF_8));
+            out.write((verb + "\r\n").getBytes(StandardCharsets.UTF_8));
             out.flush();
             String first = readLine();
-            require(first.equals("STATS"), "STATS", first);
+            require(first.equals(verb), verb, first);
             for (String line = readLine(); !line.equals("END"); line = readLine()) {
                 int c = line.indexOf(':');
                 if (c > 0) result.put(line.substring(0, c), line.substring(c + 1));

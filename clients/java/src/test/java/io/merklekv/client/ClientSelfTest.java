@@ -67,6 +67,7 @@ public final class ClientSelfTest {
 
             check(c.healthCheck(), "health check");
             check(c.stats().containsKey("total_commands"), "stats");
+            check(c.metrics() != null, "metrics round-trips");
             check(c.version().contains("."), "version");
             check(c.dbsize() >= 0, "dbsize");
         }

@@ -184,11 +184,21 @@ class MerkleKVClient(
             }
         }
 
-    fun stats(): Map<String, String> {
+    fun stats(): Map<String, String> = kvBlock("STATS")
+
+    /**
+     * Control-plane counter snapshot (METRICS extension verb): transport
+     * reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+     * node without a cluster plane.
+     */
+    fun metrics(): Map<String, String> = kvBlock("METRICS")
+
+    /** Verb whose response is `VERB` + name:value lines + END. */
+    private fun kvBlock(verb: String): Map<String, String> {
         synchronized(lock) {
-            writeLine("STATS")
+            writeLine(verb)
             val first = readLineRaiseError()
-            if (first != "STATS") throw ServerException("unexpected STATS response: $first")
+            if (first != verb) throw ServerException("unexpected $verb response: $first")
             val out = LinkedHashMap<String, String>()
             while (true) {
                 val line = readLine()

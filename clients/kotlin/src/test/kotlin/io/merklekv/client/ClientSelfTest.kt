@@ -69,6 +69,7 @@ fun main() {
 
         check(client.healthCheck(), "health check")
         check("total_commands" in client.stats(), "stats has total_commands")
+        check(client.metrics().all { ":" !in it.key }, "metrics round-trips")
         check("." in client.version(), "version has a dot")
         check(client.dbsize() >= 0, "dbsize")
 

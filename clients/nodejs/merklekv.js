@@ -292,10 +292,25 @@ class MerkleKVClient {
 
   /** @returns {Promise<Object<string,string>>} STATS counters */
   async stats() {
+    return this._kvBlock("STATS");
+  }
+
+  /**
+   * Control-plane counter snapshot (METRICS extension verb): transport
+   * reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+   * node without a cluster plane.
+   * @returns {Promise<Object<string,string>>}
+   */
+  async metrics() {
+    return this._kvBlock("METRICS");
+  }
+
+  /** Verb whose response is `VERB` + name:value lines + END. */
+  async _kvBlock(verb) {
     const run = async () => {
-      this._sock.write("STATS\r\n");
+      this._sock.write(verb + "\r\n");
       const first = await this._readLine();
-      if (first !== "STATS") throw new ServerError(`unexpected: ${first}`);
+      if (first !== verb) throw new ServerError(`unexpected: ${first}`);
       const out = {};
       for (;;) {
         const l = await this._readLine();

@@ -91,6 +91,8 @@ test("stats, health, version", async (t) => {
   assert.strictEqual(await c.healthCheck(), true);
   const stats = await c.stats();
   assert.ok("total_commands" in stats);
+  // METRICS: empty block on a bare node, but must round-trip cleanly.
+  assert.ok(typeof (await c.metrics()) === "object");
   assert.ok((await c.version()).includes("."));
 });
 

@@ -247,9 +247,27 @@ class Client
     /** @return array<string,string> */
     public function stats(): array
     {
-        $first = $this->command("STATS");
-        if ($first !== "STATS") {
-            throw new ServerError("unexpected STATS response: {$first}");
+        return $this->kvBlock("STATS");
+    }
+
+    /**
+     * Control-plane counter snapshot (METRICS extension verb): transport
+     * reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+     * node without a cluster plane.
+     * @return array<string,string>
+     */
+    public function metrics(): array
+    {
+        return $this->kvBlock("METRICS");
+    }
+
+    /** Verb whose response is VERB + name:value lines + END.
+     * @return array<string,string> */
+    private function kvBlock(string $verb): array
+    {
+        $first = $this->command($verb);
+        if ($first !== $verb) {
+            throw new ServerError("unexpected {$verb} response: {$first}");
         }
         $out = [];
         while (true) {

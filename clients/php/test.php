@@ -71,6 +71,7 @@ check($resps === ["OK", "OK", "VALUE 1", "DELETED"], "pipeline");
 // stats / health / version / dbsize
 check($c->healthCheck() === true, "health check");
 check(array_key_exists("total_commands", $c->stats()), "stats has total_commands");
+check(is_array($c->metrics()), "metrics round-trips");
 check(strpos($c->version(), ".") !== false, "version has a dot");
 check($c->dbsize() >= 0, "dbsize");
 

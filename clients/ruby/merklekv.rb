@@ -165,20 +165,12 @@ module MerkleKV
       false
     end
 
-    def stats
-      @mutex.synchronize do
-        write_line("STATS")
-        first = read_line
-        raise ServerError, "unexpected STATS response: #{first}" unless first == "STATS"
-        out = {}
-        loop do
-          line = read_line
-          return out if line == "END"
-          k, v = line.split(":", 2)
-          out[k] = v if v
-        end
-      end
-    end
+    def stats = kv_block("STATS")
+
+    # Control-plane counter snapshot (METRICS extension verb): transport
+    # reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+    # node without a cluster plane.
+    def metrics = kv_block("METRICS")
 
     def version
       expect_prefix(command("VERSION"), "VERSION ", "VERSION")
@@ -210,6 +202,22 @@ module MerkleKV
     end
 
     private
+
+    # Verb whose response is +VERB+ + name:value lines + END.
+    def kv_block(verb)
+      @mutex.synchronize do
+        write_line(verb)
+        first = read_line
+        raise ServerError, "unexpected #{verb} response: #{first}" unless first == verb
+        out = {}
+        loop do
+          line = read_line
+          return out if line == "END"
+          k, v = line.split(":", 2)
+          out[k] = v if v
+        end
+      end
+    end
 
     def check_arg(line)
       raise ArgumentError, "CR/LF forbidden in arguments" if line =~ /[\r\n]/

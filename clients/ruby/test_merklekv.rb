@@ -65,6 +65,7 @@ class TestMerkleKV < Minitest::Test
   def test_stats_health_version
     assert @c.health_check
     assert @c.stats.key?("total_commands")
+    assert_kind_of Hash, @c.metrics  # empty on a bare node; must round-trip
     assert_includes @c.version, "."
     assert_operator @c.dbsize, :>=, 0
   end

@@ -255,9 +255,23 @@ impl Client {
     }
 
     pub fn stats(&mut self) -> Result<HashMap<String, String>> {
-        let first = self.command("STATS")?;
-        if first != "STATS" {
-            return Err(Error::Protocol(format!("unexpected STATS response: {first}")));
+        self.kv_block("STATS")
+    }
+
+    /// Control-plane counter snapshot (METRICS extension verb): transport
+    /// reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+    /// node without a cluster plane.
+    pub fn metrics(&mut self) -> Result<HashMap<String, String>> {
+        self.kv_block("METRICS")
+    }
+
+    /// Verb whose response is `VERB` + name:value lines + END.
+    fn kv_block(&mut self, verb: &str) -> Result<HashMap<String, String>> {
+        let first = self.command(verb)?;
+        if first != verb {
+            return Err(Error::Protocol(format!(
+                "unexpected {verb} response: {first}"
+            )));
         }
         let mut out = HashMap::new();
         loop {

@@ -83,6 +83,7 @@ fn stats_health_version() {
     let Some(mut c) = connect() else { return };
     assert!(c.health_check());
     assert!(c.stats().unwrap().contains_key("total_commands"));
+    let _ = c.metrics().unwrap(); // empty on a bare node; must round-trip
     assert!(c.version().unwrap().contains('.'));
     let _ = c.dbsize().unwrap();
 }

@@ -181,10 +181,18 @@ class MerkleKVClient(
       case _: MerkleKVException | _: IOException => false
     }
 
-  def stats(): Map[String, String] = lock.synchronized {
-    writeLine("STATS")
+  def stats(): Map[String, String] = kvBlock("STATS")
+
+  /** Control-plane counter snapshot (METRICS extension verb): transport
+    * reconnects/outbox drops, anti-entropy loop stats. Empty on a bare
+    * node without a cluster plane. */
+  def metrics(): Map[String, String] = kvBlock("METRICS")
+
+  /** Verb whose response is `VERB` + name:value lines + END. */
+  private def kvBlock(verb: String): Map[String, String] = lock.synchronized {
+    writeLine(verb)
     val first = readLineRaiseError()
-    if (first != "STATS") throw new ServerException(s"unexpected STATS response: $first")
+    if (first != verb) throw new ServerException(s"unexpected $verb response: $first")
     val out = mutable.LinkedHashMap.empty[String, String]
     var line = readLine()
     while (line != "END") {

@@ -68,6 +68,7 @@ object ClientSelfTest {
 
       check(c.healthCheck(), "health check")
       check(c.stats().contains("total_commands"), "stats has total_commands")
+      check(c.metrics().keys.forall(k => !k.contains(":")), "metrics round-trips")
       check(c.version().contains("."), "version has a dot")
       check(c.dbsize() >= 0L, "dbsize")
 

@@ -32,6 +32,8 @@ int main(int argc, char** argv) {
   assert(c.hash().size() == 64);
   assert(c.ping());
   assert(c.echo("hello") == "hello");
+  assert(c.stats().count("total_commands") == 1);
+  (void)c.metrics();  // empty block on a bare server; must round-trip
   auto out = c.pipeline({"SET p1 a", "SET p2 b", "GET p1"});
   assert(out[0] == "OK" && out[2] == "VALUE a");
   bool threw = false;
