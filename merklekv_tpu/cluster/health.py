@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from merklekv_tpu.cluster.retry import HEALTH_PROBE, RetryPolicy
 from merklekv_tpu.utils.tracing import get_metrics
 
 __all__ = ["PeerHealth", "PeerHealthMonitor"]
@@ -26,28 +27,42 @@ __all__ = ["PeerHealth", "PeerHealthMonitor"]
 class PeerHealth:
     peer: str  # "host:port"
     # "unknown" until the first probe lands; "down" only after down_after
-    # consecutive failures; one success flips back to "up".
+    # consecutive failures; "degraded" when the peer answers probes but a
+    # sync/replication operation against it died mid-flight (reported via
+    # mark_degraded); one probe success flips degraded/down back to "up".
     status: str = "unknown"
     consecutive_failures: int = 0
     last_ok_unix: float = 0.0
     last_probe_unix: float = 0.0
     rtt_ms: float = -1.0
     probes: int = 0
+    last_error: str = ""  # most recent degradation reason, "" when healthy
 
 
 class PeerHealthMonitor:
-    """Background PING prober over the cluster's peer list."""
+    """Background PING prober over the cluster's peer list.
+
+    Probe cadence/timeout/threshold derive from the shared HEALTH_PROBE
+    policy (cluster/retry.py); explicit constructor arguments still win.
+    """
 
     def __init__(
         self,
         peers: list[str],
-        interval_seconds: float = 2.0,
-        timeout: float = 1.0,
-        down_after: int = 2,
+        interval_seconds: Optional[float] = None,
+        timeout: Optional[float] = None,
+        down_after: Optional[int] = None,
+        policy: Optional[RetryPolicy] = None,
     ) -> None:
-        self._interval = interval_seconds
-        self._timeout = timeout
-        self._down_after = down_after
+        policy = policy if policy is not None else HEALTH_PROBE
+        self._interval = (
+            interval_seconds if interval_seconds is not None
+            else policy.first_delay
+        )
+        self._timeout = timeout if timeout is not None else policy.op_timeout
+        self._down_after = (
+            down_after if down_after is not None else (policy.attempts or 2)
+        )
         self._mu = threading.Lock()
         self._health: dict[str, PeerHealth] = {
             p: PeerHealth(peer=p) for p in peers
@@ -116,6 +131,7 @@ class PeerHealthMonitor:
                 h.consecutive_failures = 0
                 h.last_ok_unix = now
                 h.rtt_ms = rtt_ms
+                h.last_error = ""
             else:
                 h.consecutive_failures += 1
                 if (
@@ -134,6 +150,22 @@ class PeerHealthMonitor:
                 get_metrics().inc("health.probe_errors")
             if self._stop.wait(self._interval):
                 return
+
+    # -- external failure reports --------------------------------------------
+    def mark_degraded(self, peer: str, reason: str = "") -> None:
+        """A component saw ``peer`` fail mid-operation (sync stream died,
+        injected fault, repair deadline expired) even though probes may
+        still succeed. The table shows it, metrics count it, and the next
+        successful probe clears it. Peers not in the configured list are
+        added so ad-hoc sync targets surface too."""
+        with self._mu:
+            h = self._health.get(peer)
+            if h is None:
+                h = self._health[peer] = PeerHealth(peer=peer)
+            h.last_error = reason
+            if h.status != "down":
+                h.status = "degraded"
+        get_metrics().inc("health.peer_degradations")
 
     # -- queries -------------------------------------------------------------
     def is_up(self, peer: str) -> bool:
@@ -155,7 +187,12 @@ class PeerHealthMonitor:
             out += (
                 f"addr={h.peer} status={h.status} "
                 f"failures={h.consecutive_failures} "
-                f"rtt_ms={h.rtt_ms:.2f} last_ok={int(h.last_ok_unix)}\r\n"
+                f"rtt_ms={h.rtt_ms:.2f} last_ok={int(h.last_ok_unix)}"
             )
+            if h.last_error:
+                # k=v fields are space-separated on the wire; the free-text
+                # reason is squeezed so it stays one field.
+                out += f" error={h.last_error.replace(' ', '_')[:80]}"
+            out += "\r\n"
         out += "END\r\n"
         return out

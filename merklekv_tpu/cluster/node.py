@@ -42,6 +42,7 @@ class ClusterNode:
             engine,
             device=cfg.anti_entropy.engine,
             repair_listener=self._on_sync_repair,
+            on_peer_degraded=self._on_peer_degraded,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -141,6 +142,14 @@ class ClusterNode:
                 # warm thread reads through the engine's raw pointer.
                 self._mirror.close()
                 self._mirror = None
+
+    def _on_peer_degraded(self, peer: str, reason: str) -> None:
+        """A sync stream against ``peer`` died mid-cycle (its remaining
+        repairs are checkpointed for resume); reflect it in the health
+        table so PEERS shows the degradation while probes keep running."""
+        h = self._health
+        if h is not None:
+            h.mark_degraded(peer, reason)
 
     def _on_sync_repair(self, key: bytes, value) -> None:
         """Anti-entropy repairs bypass the server event queue; feed the

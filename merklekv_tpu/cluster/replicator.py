@@ -32,6 +32,7 @@ from merklekv_tpu.cluster.change_event import (
     decode_any,
     encode_cbor,
 )
+from merklekv_tpu.cluster.retry import REPLICATOR_PUBLISH, RetryPolicy
 from merklekv_tpu.cluster.transport import Transport
 from merklekv_tpu.native_bindings import (
     OP_APPEND,
@@ -70,6 +71,7 @@ class Replicator:
         drain_interval: float = 0.005,
         batch_listener: Optional[Callable[[list[ChangeEvent]], None]] = None,
         mirror=None,  # Optional[DeviceTreeMirror]
+        retry: Optional[RetryPolicy] = None,
     ) -> None:
         self._engine = engine
         self._server = server
@@ -79,6 +81,10 @@ class Replicator:
         self._drain_interval = drain_interval
         self._batch_listener = batch_listener
         self._mirror = mirror
+        # Publish retry under the shared cluster policy: one near-immediate
+        # retry for a transient transport hiccup, then drop and count
+        # (QoS-0 by design; anti-entropy repairs the residue).
+        self._retry = retry if retry is not None else REPLICATOR_PUBLISH
 
         # Remote applies install the EVENT's timestamp through the engine's
         # LWW-conditional ops (set_if_newer / del_if_newer), so replication
@@ -170,8 +176,13 @@ class Replicator:
                 # TRUNCATE stays local: it only invalidates device mirrors.
                 if ev.op is OpKind.TRUNCATE:
                     continue
+                payload = encode_cbor(ev)
                 try:
-                    self._transport.publish(self._topic, encode_cbor(ev))
+                    self._retry.run(
+                        lambda: self._transport.publish(self._topic, payload),
+                        retry_on=(Exception,),
+                        should_stop=self._stop.is_set,
+                    )
                     published += 1
                 except Exception:
                     # QoS-0 fabric: drop and count; anti-entropy repairs.

@@ -36,14 +36,16 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
-from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.client import MerkleKVClient, MerkleKVError, ProtocolError
+from merklekv_tpu.cluster.retry import SYNC_PEER, Deadline, RetryPolicy
 from merklekv_tpu.merkle.encoding import leaf_hash
 from merklekv_tpu.native_bindings import NativeEngine
+from merklekv_tpu.utils import jaxenv
 from merklekv_tpu.utils.tracing import get_metrics, span
 
-__all__ = ["SyncManager", "SyncReport", "MultiSyncReport"]
+__all__ = ["SyncManager", "SyncReport", "MultiSyncReport", "SyncSession"]
 
 # Below this many union keys the device round-trip costs more than hashlib
 # (measured: a 10K-key cycle is ~2.7x faster on the host path even with a
@@ -65,6 +67,11 @@ class MultiSyncReport:
     values_fetched: int = 0
     seconds: float = 0.0
     details: list[str] = field(default_factory=list)
+    # Peers whose repair stream died mid-cycle: their remaining work is
+    # checkpointed as a SyncSession and they are reported to the health
+    # monitor; the rest of the cycle proceeds.
+    degraded: list[str] = field(default_factory=list)
+    resumed_peers: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -76,10 +83,49 @@ class SyncReport:
     set_keys: int = 0
     deleted_keys: int = 0
     values_fetched: int = 0  # values transferred (== divergent when hash-first)
-    mode: str = ""  # "noop" | "hash-first" | "full" | "full-fallback"
+    mode: str = ""  # "noop" | "hash-paged" | "hash-first" | "full" | "full-fallback"
     verified: Optional[bool] = None  # post-sync root recheck (--verify)
+    resumed: bool = False  # this cycle continued an interrupted session
     seconds: float = 0.0
     details: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SyncSession:
+    """Checkpoint of an interrupted per-peer repair.
+
+    When a peer dies (or an injected fault kills the stream) mid-repair,
+    the work already applied stays applied and the REMAINING divergent
+    keys are kept here; the next cycle against the peer repairs these
+    first — resuming from the last verified leaf instead of restarting
+    the whole diff. Conceptually the pending list is the frontier of
+    not-yet-verified subtrees: divergent leaves are repaired in sorted
+    order, so everything before the checkpoint is an already-converged
+    prefix of the tree.
+    """
+
+    peer: str
+    pending_sets: list[tuple[bytes, int]]  # (key, last-write ts) to fetch
+    repaired: int = 0  # keys applied before the interruption
+    attempts: int = 0
+    # Paged hash-scan resume position (exclusive): every key <= cursor was
+    # verified/repaired before the interruption, so the next cycle's walk
+    # starts here instead of refetching the whole hash list. b"" = the walk
+    # had not begun (or the peer doesn't serve HASHPAGE).
+    cursor: bytes = b""
+    # Adaptive page size carried across cycles: a walk that died shrinks
+    # the next attempt's pages (less exposure per round trip on a hostile
+    # link); clean pages grow it back toward the configured maximum.
+    # 0 = start from the SyncManager default.
+    page_size: int = 0
+    created_unix: float = field(default_factory=time.time)
+
+
+# A session that keeps failing (or outlives remote churn) is abandoned and
+# the next cycle runs a fresh full diff — resume is an optimization, never
+# a correctness dependency (the root compare after resume re-verifies).
+_SESSION_MAX_ATTEMPTS = 8
+_SESSION_MAX_AGE_S = 600.0
 
 
 def _leaf_map_device(items: list[tuple[bytes, bytes]]) -> dict[bytes, bytes]:
@@ -96,8 +142,13 @@ def _leaf_map_device(items: list[tuple[bytes, bytes]]) -> dict[bytes, bytes]:
 
 
 def _leaf_map(items: list[tuple[bytes, bytes]], use_device: bool) -> dict[bytes, bytes]:
-    if use_device:
-        return _leaf_map_device(items)
+    if use_device and not jaxenv.device_failed():
+        try:
+            return _leaf_map_device(items)
+        except Exception as e:
+            # TPU/Pallas init failure degrades to host hashing (one-time
+            # warning) instead of killing every anti-entropy cycle.
+            jaxenv.note_device_failure(e, "leaf hashing")
     return {k: leaf_hash(k, v) for k, v in items}
 
 
@@ -123,21 +174,102 @@ class SyncManager:
         engine: NativeEngine,
         device: str = "auto",  # "auto" | "cpu" | "tpu"
         mget_batch: int = 512,
-        timeout: float = 30.0,
+        timeout: Optional[float] = None,
         repair_listener=None,  # Callable[[bytes, Optional[bytes]], None]
+        retry: Optional[RetryPolicy] = None,
+        on_peer_degraded: Optional[Callable[[str, str], None]] = None,
+        hash_page: int = 512,
     ) -> None:
         self._engine = engine
         self._device = device
         self._mget_batch = mget_batch
-        self._timeout = timeout
+        # Keys per HASHPAGE fetch in the paged pairwise walk. Smaller pages
+        # bound how much verified progress one dead stream can destroy (a
+        # page is the resume granularity); larger pages amortize round
+        # trips on clean links.
+        self._hash_page = hash_page
+        # Per-op timeout / connect retries / per-peer cycle deadline come
+        # from ONE policy object (cluster/retry.py) instead of scattered
+        # constants; an explicit timeout still wins for callers that need
+        # a different socket budget.
+        self._retry = retry if retry is not None else SYNC_PEER
+        self._timeout = timeout if timeout is not None else self._retry.op_timeout
         # Repairs write through the engine bindings, bypassing the server's
         # event queue — anything mirroring the keyspace (the device Merkle
         # tree) must be told explicitly or it serves stale roots forever.
         self._repair_listener = repair_listener
+        # Mid-sync failure hook: the peer is reported degraded (health.py
+        # flips its table entry) while its checkpointed session waits.
+        self._on_peer_degraded = on_peer_degraded
+        self._sessions: dict[str, SyncSession] = {}
+        # First-checkpoint time per peer, surviving resume/re-checkpoint
+        # churn: a re-checkpoint builds a fresh SyncSession, and without
+        # this the 10-minute abandonment clock would restart every cycle.
+        self._session_born: dict[str, float] = {}
+        # Peers degraded during the current cycle — lets the loop's
+        # catch-all skip a second, reason-losing _degrade for failures the
+        # cycle already reported.
+        self._degraded_this_cycle: set[str] = set()
         self._loop_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_report: Optional[SyncReport] = None
         self.last_multi_report: Optional[MultiSyncReport] = None
+
+    # -- failure bookkeeping --------------------------------------------------
+    def _degrade(self, peer: str, reason: str) -> None:
+        get_metrics().inc("anti_entropy.peer_degraded")
+        self._degraded_this_cycle.add(peer)
+        if self._on_peer_degraded is not None:
+            try:
+                self._on_peer_degraded(peer, reason)
+            except Exception:
+                pass  # a broken health hook must never stall repairs
+
+    def session_for(self, peer: str) -> Optional[SyncSession]:
+        """The checkpointed session for ``peer`` (introspection/tests)."""
+        return self._sessions.get(peer)
+
+    def _checkpoint(
+        self,
+        peer: str,
+        pending: list[tuple[bytes, int]],
+        repaired: int,
+        attempts: int = 0,
+        cursor: bytes = b"",
+        page_size: int = 0,
+    ) -> None:
+        self._sessions[peer] = SyncSession(
+            peer=peer,
+            pending_sets=pending,
+            repaired=repaired,
+            attempts=attempts,
+            cursor=cursor,
+            page_size=page_size,
+            created_unix=self._session_born.setdefault(peer, time.time()),
+        )
+        get_metrics().inc("anti_entropy.sessions_checkpointed")
+
+    def _take_session(self, peer: str) -> Optional[SyncSession]:
+        """Pop a resumable session, discarding it when stale/exhausted."""
+        sess = self._sessions.pop(peer, None)
+        if sess is None:
+            return None
+        if (
+            sess.attempts >= _SESSION_MAX_ATTEMPTS
+            or time.time() - sess.created_unix > _SESSION_MAX_AGE_S
+        ):
+            get_metrics().inc("anti_entropy.sessions_abandoned")
+            self._session_born.pop(peer, None)
+            return None
+        sess.attempts += 1
+        return sess
+
+    def _session_done(self, peer: str) -> None:
+        """Clear the abandonment clock once no session remains for the
+        peer (fully drained or abandoned) — a stale birth time would make
+        some future, unrelated session look instantly over-age."""
+        if peer not in self._sessions:
+            self._session_born.pop(peer, None)
 
     # -- one-shot ------------------------------------------------------------
     def sync_once(
@@ -155,45 +287,178 @@ class SyncManager:
         self, host: str, port: int, full: bool, verify: bool
     ) -> SyncReport:
         t0 = time.perf_counter()
-        report = SyncReport(peer=f"{host}:{port}")
+        peer = f"{host}:{port}"
+        report = SyncReport(peer=peer)
+        deadline = self._retry.deadline()
+        self._degraded_this_cycle.discard(peer)
 
-        with MerkleKVClient(host, port, timeout=self._timeout) as client:
-            # Root comparison first, on the same connection the snapshot
-            # would use: equal Merkle roots mean equal keyspaces, so no
-            # snapshot travels at all. (The reference documents a
-            # hash-compare walk but ships full-state transfer
-            # unconditionally — SURVEY §3.4.)
-            local_root = self._engine.merkle_root()
-            local_hex = local_root.hex() if local_root is not None else "0" * 64
-            try:
-                roots_equal = client.hash() == local_hex
-            except Exception as e:
-                # A peer that serves data but not HASH still syncs — but
-                # record the degradation instead of hiding it.
-                get_metrics().inc("anti_entropy.probe_failures")
-                report.details.append(f"hash probe failed: {e!r}")
-                roots_equal = False
-            if roots_equal:
-                report.mode = "noop"
-                report.verified = True if verify else None
-                report.seconds = time.perf_counter() - t0
-                report.details.append("roots equal; no transfer")
-                self.last_report = report
-                return report
+        client = MerkleKVClient(host, port, timeout=self._timeout)
+        try:
+            self._retry.run(
+                client.connect,
+                retry_on=(OSError, MerkleKVError),
+                deadline=deadline,
+            )
+        except Exception as e:
+            self._degrade(peer, f"connect failed: {e!r}")
+            raise
+        try:
+            # A previous cycle's interrupted repair resumes FIRST: the
+            # checkpointed frontier is repaired from the last verified
+            # leaf, then the root compare below re-verifies convergence
+            # (resume is a fast-path, never a correctness dependency).
+            sess = self._take_session(peer)
+            if sess is not None:
+                report.resumed = True
+                report.details.append(
+                    f"resuming session: {len(sess.pending_sets)} pending, "
+                    f"{sess.repaired} already repaired, "
+                    f"cursor {sess.cursor!r}, attempt {sess.attempts}"
+                )
+                get_metrics().inc("anti_entropy.sessions_resumed")
 
-            if full:
-                report.mode = "full"
-                self._sync_full(client, report)
-            else:
-                remote_hashes = self._fetch_remote_hashes(client, report)
-                if remote_hashes is None:
-                    report.mode = "full-fallback"
-                    self._sync_full(client, report)
-                else:
-                    report.mode = "hash-first"
-                    self._sync_hash_first(client, remote_hashes, report)
+            # One cycle survives stream deaths: every failure below leaves
+            # a checkpoint (cursor + unapplied page remainder), so instead
+            # of surrendering the cycle the loop reconnects — the old
+            # socket's desync dies with it — and continues from the
+            # checkpoint, until the cycle deadline or reconnect budget is
+            # spent. Only then does the error propagate, with the session
+            # retained for the NEXT cycle to resume.
+            reconnects = 0
+            while True:
+                try:
+                    if sess is not None and sess.pending_sets:
+                        # Conditional installs: the checkpoint may be older
+                        # than fresh local writes — LWW must still win. A
+                        # re-checkpoint on failure carries the scan cursor
+                        # forward so the unfinished walk is not lost.
+                        self._repair_sets_resumable(
+                            client, peer, sess.pending_sets, report,
+                            deadline, lww=True,
+                            already_repaired=sess.repaired,
+                            prior_attempts=sess.attempts, cursor=sess.cursor,
+                        )
+                        sess.pending_sets = []
+                        if peer in self._sessions:
+                            # Deadline expired mid-resume: the remainder is
+                            # already checkpointed (and the peer degraded);
+                            # entering the walk below would see the same
+                            # expired deadline and clobber that checkpoint
+                            # with an empty pending list.
+                            report.seconds = time.perf_counter() - t0
+                            self.last_report = report
+                            return report
 
-            if verify:
+                    # Root comparison on the same connection the snapshot
+                    # would use: equal Merkle roots mean equal keyspaces,
+                    # so no snapshot travels at all. Skipped when resuming
+                    # mid-walk — the unwalked tail makes inequality near
+                    # certain, and the probe is one more round trip a
+                    # hostile link can kill. (The reference documents a
+                    # hash-compare walk but ships full-state transfer
+                    # unconditionally — SURVEY §3.4.)
+                    mid_walk = sess is not None and sess.cursor != b""
+                    if not mid_walk:
+                        local_root = self._engine.merkle_root()
+                        local_hex = (
+                            local_root.hex()
+                            if local_root is not None
+                            else "0" * 64
+                        )
+                        try:
+                            roots_equal = client.hash() == local_hex
+                        except Exception as e:
+                            # A peer that serves data but not HASH still
+                            # syncs — record the degradation, don't hide it.
+                            get_metrics().inc("anti_entropy.probe_failures")
+                            report.details.append(f"hash probe failed: {e!r}")
+                            roots_equal = False
+                        if roots_equal:
+                            report.mode = "noop"
+                            report.verified = True if verify else None
+                            report.seconds = time.perf_counter() - t0
+                            report.details.append("roots equal; no transfer")
+                            self.last_report = report
+                            return report
+
+                    if full:
+                        report.mode = "full"
+                        self._sync_full(client, report)
+                    else:
+                        # Paged walk first: each HASHPAGE covers one small
+                        # key range, repaired before the next is fetched,
+                        # so a killed stream loses at most one page of
+                        # progress.
+                        paged = self._sync_hash_paged(
+                            client, report, deadline,
+                            start=sess.cursor if sess is not None else b"",
+                            prior_attempts=(
+                                sess.attempts if sess is not None else 0
+                            ),
+                            start_page=(
+                                sess.page_size if sess is not None else 0
+                            ),
+                        )
+                        if not paged:
+                            # Peer predates HASHPAGE: monolithic
+                            # LEAFHASHES, then full transfer as the last
+                            # resort — all-or-nothing paths, kept only for
+                            # old peers.
+                            remote_hashes = self._fetch_remote_hashes(
+                                client, report
+                            )
+                            if remote_hashes is None:
+                                report.mode = "full-fallback"
+                                self._sync_full(client, report)
+                            else:
+                                report.mode = "hash-first"
+                                self._sync_hash_first(
+                                    client, remote_hashes, report, deadline
+                                )
+                    break
+                except (MerkleKVError, OSError):
+                    nsess = self._sessions.pop(peer, None)
+                    out_of_budget = (
+                        reconnects >= self._MAX_CYCLE_RECONNECTS
+                        or deadline.expired()
+                        or self._stop.is_set()
+                    )
+                    if nsess is None or out_of_budget:
+                        if nsess is not None:
+                            self._sessions[peer] = nsess  # keep for next cycle
+                        raise
+                    time.sleep(deadline.clamp(
+                        self._retry.backoff(reconnects)
+                    ))
+                    reconnects += 1
+                    client.close()
+                    try:
+                        self._retry.run(
+                            client.connect,
+                            retry_on=(OSError, MerkleKVError),
+                            deadline=deadline,
+                        )
+                    except Exception:
+                        self._sessions[peer] = nsess  # keep for next cycle
+                        raise
+                    sess = nsess
+                    get_metrics().inc("anti_entropy.cycle_reconnects")
+                    report.details.append(
+                        f"stream died; reconnected (#{reconnects}), "
+                        f"resuming at cursor {nsess.cursor!r} with "
+                        f"{len(nsess.pending_sets)} pending"
+                    )
+
+            if verify and peer in self._sessions:
+                # The cycle deliberately checkpointed mid-walk (deadline
+                # expiry): roots are necessarily unequal, and raising here
+                # would misreport the designed resume path as corruption.
+                report.verified = False
+                report.details.append(
+                    "verify skipped: cycle checkpointed mid-walk; "
+                    "resuming next cycle"
+                )
+            elif verify:
                 local_root = self._engine.merkle_root()
                 local_hex = (
                     local_root.hex() if local_root is not None else "0" * 64
@@ -207,10 +472,180 @@ class SyncManager:
                         f"sync verify failed: roots differ after repair "
                         f"(peer {report.peer})"
                     )
+        finally:
+            client.close()
+            self._session_done(peer)
 
         report.seconds = time.perf_counter() - t0
         self.last_report = report
         return report
+
+    # -- paged hash walk (primary pairwise path) ------------------------------
+    _MIN_HASH_PAGE = 16
+    # In-cycle reconnect budget: a hostile link can kill every page stream;
+    # the cycle keeps reconnecting and resuming from its checkpoint until
+    # this cap (or the cycle deadline) is hit, then leaves the session for
+    # the next cycle. Backoff between reconnects comes from the retry
+    # policy, so the cap mostly guards against pathological fast-fail loops.
+    _MAX_CYCLE_RECONNECTS = 32
+
+    def _sync_hash_paged(
+        self,
+        client: MerkleKVClient,
+        report: SyncReport,
+        deadline: Optional[Deadline],
+        start: bytes,
+        prior_attempts: int = 0,
+        start_page: int = 0,
+    ) -> bool:
+        """Cursor-paged pairwise repair: fetch the peer's hash list one
+        sorted key-range page at a time (HASHPAGE), repairing each page
+        before fetching the next. The cursor only advances past a page once
+        its repairs are applied, so the prefix <= cursor is a VERIFIED
+        subtree: an injected fault or peer death mid-walk checkpoints
+        (cursor, unapplied page remainder) and the next cycle resumes there
+        instead of restarting. The page size adapts — halved after a dead
+        stream (smaller exposure per round trip on a hostile link), doubled
+        after a clean page, bounded by [``_MIN_HASH_PAGE``, ``hash_page``] —
+        so progress stays monotonic under fault rates that would kill any
+        all-or-nothing transfer. Returns False when the peer does not serve
+        HASHPAGE (caller degrades to the monolithic paths)."""
+        peer = report.peer
+        # The local snapshot + hash pass is deferred until the first page
+        # proves the peer serves HASHPAGE: against an old peer this path
+        # bails to the monolithic fallback, which computes its own
+        # snapshot/hashes — hashing up front would double that cost every
+        # cycle for the whole upgrade window.
+        local_keys: list[bytes] = []
+        local_hashes: Optional[dict[bytes, bytes]] = None
+        report.mode = "hash-paged"
+
+        import bisect
+
+        cursor = start
+        size = min(start_page or self._hash_page, self._hash_page)
+        size = max(size, self._MIN_HASH_PAGE)
+        pages = 0
+
+        def progressed() -> bool:
+            return cursor != start or report.set_keys + report.deleted_keys > 0
+
+        def shrunk() -> int:
+            return max(self._MIN_HASH_PAGE, size // 2)
+
+        def attempts_now() -> int:
+            return 0 if progressed() else prior_attempts
+
+        while True:
+            if deadline is not None and deadline.expired():
+                self._checkpoint(peer, [], 0, attempts_now(),
+                                 cursor=cursor, page_size=size)
+                self._degrade(peer, "per-peer cycle deadline expired")
+                report.details.append(
+                    f"{peer}: deadline expired mid-walk; cursor "
+                    f"{cursor!r} checkpointed after {pages} pages"
+                )
+                return True
+            try:
+                rows, done = client.leaf_hashes_page(
+                    size, cursor.decode("utf-8", "surrogateescape")
+                )
+            except ProtocolError as e:
+                if pages == 0 and "unknown command" in str(e).lower():
+                    return False  # old peer: no HASHPAGE verb
+                # Mid-walk protocol garbage is a corrupted stream, not a
+                # capability miss: keep the verified prefix and abort the
+                # cycle (the next one resumes from the cursor).
+                self._checkpoint(peer, [], 0, attempts_now(),
+                                 cursor=cursor, page_size=shrunk())
+                self._degrade(peer, f"hash walk stream corrupted: {e!r}")
+                get_metrics().inc("anti_entropy.interrupted_repairs")
+                raise
+            except (MerkleKVError, OSError) as e:
+                self._checkpoint(peer, [], 0, attempts_now(),
+                                 cursor=cursor, page_size=shrunk())
+                self._degrade(peer, f"hash walk died: {e!r}")
+                get_metrics().inc("anti_entropy.interrupted_repairs")
+                raise
+            if local_hashes is None:
+                # One local hash pass per cycle (device-batched when the
+                # keyspace is big enough) — per-page hashing would forfeit
+                # the batching win.
+                local_items = self._engine.snapshot()  # sorted (key, value)
+                local_keys = [k for k, _ in local_items]
+                local_hashes = _leaf_map(
+                    local_items, self._use_device(len(local_items))
+                )
+                report.local_keys = len(local_items)
+            pages += 1
+
+            page: list[tuple[bytes, Optional[bytes], int]] = [
+                (
+                    k.encode("utf-8", "surrogateescape"),
+                    bytes.fromhex(h) if h is not None else None,
+                    ts,
+                )
+                for k, h, ts in rows
+            ]
+            page_keys = {k for k, _, _ in page}
+            # Covered local range: (cursor, last page key], or everything
+            # past the cursor once the peer reports the scan exhausted.
+            lo = bisect.bisect_right(local_keys, cursor)
+            hi = (
+                len(local_keys)
+                if done
+                else bisect.bisect_right(local_keys, page[-1][0])
+            )
+
+            # Deletions first — engine-local, nothing to interrupt. A key
+            # the page skips is absent on the peer (mirror its absence); a
+            # tombstone row carries the peer's deletion ts to adopt.
+            to_set: list[tuple[bytes, int]] = []
+            for k, digest, ts in page:
+                if digest is None:
+                    # ts 0 is the server's "state unknown" sentinel (key
+                    # vanished between page selection and read, tombstone
+                    # evicted): adopting it would delete newer local data.
+                    # The key stays in page_keys so the mirror-absence
+                    # sweep below skips it too; the next cycle repairs it.
+                    if ts != 0 and k in local_hashes:
+                        self._repair_delete(k, tomb_ts=ts)
+                        report.deleted_keys += 1
+                        report.divergent += 1
+                    continue
+                report.remote_keys += 1
+                if local_hashes.get(k) != digest:
+                    to_set.append((k, ts))
+            for k in local_keys[lo:hi]:
+                if k not in page_keys:
+                    self._repair_delete(k)
+                    report.deleted_keys += 1
+                    report.divergent += 1
+            report.divergent += len(to_set)
+
+            next_cursor = page[-1][0] if page else cursor
+            # Value repairs for this page; a death here checkpoints the
+            # page remainder WITH the advanced cursor (deletes are already
+            # applied and the pending list captures the unapplied sets).
+            try:
+                self._repair_sets_resumable(
+                    client, peer, to_set, report, deadline, lww=False,
+                    cursor=next_cursor,
+                )
+            except Exception:
+                sess = self._sessions.get(peer)
+                if sess is not None:
+                    sess.page_size = shrunk()
+                raise
+            if peer in self._sessions:
+                # Deadline checkpoint inside the repair loop — not a link
+                # fault, so the page size carries over unshrunk.
+                self._sessions[peer].page_size = size
+                return True
+            cursor = next_cursor
+            size = min(self._hash_page, size * 2)
+            if done:
+                return True
 
     # -- hash-first path ------------------------------------------------------
     def _fetch_remote_hashes(
@@ -223,8 +658,23 @@ class SyncManager:
             # or a future wire extension this reader doesn't know) must
             # degrade to the full-transfer fallback, not kill the cycle.
             return _decode_leaf_map(client.leaf_hashes_ts())
-        except Exception as e:
+        except ProtocolError as e:
+            # The peer answered but can't serve the verb (ERROR response):
+            # the one case where full transfer is the right degradation.
             report.details.append(f"LEAFHASHES unsupported: {e!r}")
+            get_metrics().inc("anti_entropy.leafhash_fallbacks")
+            return None
+        except (MerkleKVError, OSError):
+            # Transport death mid-fetch. Falling back to full transfer
+            # would push the ENTIRE keyspace over the same dying link —
+            # strictly worse. Abort the cycle; the loop retries next round
+            # (and any checkpointed session resumes).
+            get_metrics().inc("anti_entropy.leafhash_aborts")
+            raise
+        except Exception as e:
+            # Malformed payload from a live peer: full transfer re-derives
+            # the hashes locally and still converges.
+            report.details.append(f"LEAFHASHES undecodable: {e!r}")
             get_metrics().inc("anti_entropy.leafhash_fallbacks")
             return None
 
@@ -233,6 +683,7 @@ class SyncManager:
         client: MerkleKVClient,
         remote_hashes: dict[bytes, tuple[Optional[bytes], int]],
         report: SyncReport,
+        deadline: Optional[Deadline] = None,
     ) -> None:
         local = {k: v for k, v in self._engine.snapshot()}
         # Live digests and tombstones arrive in one LEAFHASHES payload;
@@ -253,21 +704,87 @@ class SyncManager:
         divergent = self._diff(local_hashes, remote_digests, use_device)
         report.divergent = len(divergent)
 
-        to_fetch = [k for k in divergent if k in remote_digests]
-        values = self._fetch_values(client, to_fetch)
-        report.values_fetched = len(values)
+        # Local deletions first — no network involved, cannot be
+        # interrupted by a peer death.
+        to_set: list[tuple[bytes, int]] = []
         for k in divergent:
             if k in remote_digests:
-                if k in values:
-                    # Propagate the peer's last-write ts with the value so
-                    # LWW ordering metadata survives the repair.
-                    self._repair_set(k, values[k], remote_hashes[k][1])
-                    report.set_keys += 1
-                # else: deleted on the peer between LEAFHASHES and MGET;
-                # the next cycle repairs it.
+                # Propagate the peer's last-write ts with the value so
+                # LWW ordering metadata survives the repair.
+                to_set.append((k, remote_hashes[k][1]))
             else:
                 self._repair_delete(k, tomb_ts=remote_tombs.get(k))
                 report.deleted_keys += 1
+        # Value repairs apply BATCH BY BATCH in sorted key order so a peer
+        # death mid-stream leaves a converged prefix applied and a
+        # checkpointed remainder to resume, instead of losing the cycle.
+        self._repair_sets_resumable(
+            client, report.peer, to_set, report, deadline, lww=False
+        )
+
+    def _repair_sets_resumable(
+        self,
+        client: MerkleKVClient,
+        peer: str,
+        pairs: list[tuple[bytes, int]],
+        report,  # SyncReport | MultiSyncReport (shared counter fields)
+        deadline: Optional[Deadline],
+        lww: bool,
+        already_repaired: int = 0,
+        prior_attempts: int = 0,
+        cursor: bytes = b"",
+    ) -> None:
+        """Fetch+apply ``pairs`` in mget batches; checkpoint on failure.
+
+        On a transport error (or an expired per-peer deadline) the
+        remaining pairs become a SyncSession, the peer is marked degraded,
+        and the error propagates (deadline expiry returns silently — the
+        loop simply continues next interval). Keys repaired before the
+        interruption STAY repaired. An attempt that made ANY progress
+        re-earns its retries (attempts reset); only a stalled session is
+        eventually abandoned by ``_take_session``.
+        """
+        repaired = already_repaired
+
+        def attempts_now() -> int:
+            return prior_attempts if repaired == already_repaired else 0
+
+        for i in range(0, len(pairs), self._mget_batch):
+            if deadline is not None and deadline.expired():
+                self._checkpoint(peer, pairs[i:], repaired, attempts_now(),
+                                 cursor=cursor)
+                self._degrade(peer, "per-peer cycle deadline expired")
+                report.details.append(
+                    f"{peer}: deadline expired; {len(pairs) - i} repairs "
+                    "checkpointed"
+                )
+                return
+            batch = pairs[i : i + self._mget_batch]
+            try:
+                values = self._fetch_values(client, [k for k, _ in batch])
+            except Exception as e:
+                self._checkpoint(peer, pairs[i:], repaired, attempts_now(),
+                                 cursor=cursor)
+                self._degrade(peer, f"repair stream died: {e!r}")
+                report.details.append(
+                    f"{peer}: interrupted mid-repair ({e!r}); "
+                    f"{len(pairs) - i} repairs checkpointed"
+                )
+                get_metrics().inc("anti_entropy.interrupted_repairs")
+                raise
+            report.values_fetched += len(values)
+            for k, ts in batch:
+                if k not in values:
+                    # Deleted on the peer between LEAFHASHES and MGET;
+                    # the next cycle repairs it.
+                    continue
+                if lww:
+                    if self._repair_set_lww(k, values[k], ts):
+                        report.set_keys += 1
+                else:
+                    self._repair_set(k, values[k], ts)
+                    report.set_keys += 1
+                repaired += 1
 
     # -- full path (reference behavior; --full or LEAFHASHES-less peer) -------
     def _sync_full(self, client: MerkleKVClient, report: SyncReport) -> None:
@@ -387,6 +904,7 @@ class SyncManager:
 
         t0 = time.perf_counter()
         report = MultiSyncReport(peers=list(peers))
+        deadline = self._retry.deadline()
 
         # Gather peer leaf-hash+ts maps; a down peer is skipped this cycle.
         clients: list[Optional[MerkleKVClient]] = []
@@ -411,6 +929,37 @@ class SyncManager:
             except Exception as e:
                 drop_peer(c, f"{peer}: unreachable ({e!r})")
                 continue
+            # An interrupted repair from a previous cycle resumes before
+            # this cycle's arbitration, so the local snapshot below already
+            # reflects the repaired prefix.
+            sess = self._take_session(peer)
+            if sess is not None:
+                report.resumed_peers.append(peer)
+                get_metrics().inc("anti_entropy.sessions_resumed")
+                try:
+                    # cursor threads through so a re-checkpoint on failure
+                    # keeps the paged walk's verified prefix — without it a
+                    # failed resume would store cursor=b"" and the next
+                    # sync_once would restart the walk from scratch.
+                    self._repair_sets_resumable(
+                        c, peer, sess.pending_sets, report, deadline,
+                        lww=True, already_repaired=sess.repaired,
+                        prior_attempts=sess.attempts, cursor=sess.cursor,
+                    )
+                except Exception as e:
+                    drop_peer(c, f"{peer}: resume interrupted ({e!r})")
+                    report.degraded.append(peer)
+                    continue
+                if peer in self._sessions:
+                    # Deadline expired mid-resume (silent checkpoint):
+                    # arbitration for this peer would run on a spent budget
+                    # and its wants-loop re-checkpoint would overwrite the
+                    # saved paged-walk cursor with b"".
+                    drop_peer(
+                        c, f"{peer}: deadline expired mid-resume; checkpointed"
+                    )
+                    report.degraded.append(peer)
+                    continue
             try:
                 decoded = _decode_leaf_map(c.leaf_hashes_ts())
             except Exception:
@@ -470,12 +1019,18 @@ class SyncManager:
                 report.seconds = time.perf_counter() - t0
                 return report
             if use_device:
-                from merklekv_tpu.utils.jaxenv import ensure_platform
+                try:
+                    from merklekv_tpu.utils.jaxenv import ensure_platform
 
-                ensure_platform()
-                masks = np.asarray(
-                    divergence_masks(aligned.digests, aligned.present)
-                )
+                    ensure_platform()
+                    masks = np.asarray(
+                        divergence_masks(aligned.digests, aligned.present)
+                    )
+                except Exception as e:
+                    jaxenv.note_device_failure(e, "divergence masks")
+                    masks = divergence_masks_np(
+                        aligned.digests, aligned.present
+                    )
             else:
                 masks = divergence_masks_np(aligned.digests, aligned.present)
             report.per_peer_divergent = {
@@ -574,22 +1129,34 @@ class SyncManager:
                 wants.setdefault(live[ws - 1], []).append((key, winner_ts))
 
             for r, pairs in wants.items():
-                values = self._fetch_values(clients[r], [k for k, _ in pairs])
-                report.values_fetched += len(values)
-                for k, ts in pairs:
-                    if k in values:
-                        if self._repair_set_lww(k, values[k], ts):
-                            report.set_keys += 1
+                # A peer dying mid-fetch no longer aborts the whole cycle:
+                # its remaining repairs are checkpointed (resumed next
+                # cycle), it is marked degraded, and the other peers'
+                # repairs proceed.
+                peer = peers[r]
+                try:
+                    self._repair_sets_resumable(
+                        clients[r], peer, pairs, report, deadline, lww=True
+                    )
+                except Exception:
+                    report.degraded.append(peer)
+                    continue
+                if peer in self._sessions:  # deadline checkpoint, no raise
+                    report.degraded.append(peer)
         finally:
             for c in clients:
                 if c is not None:
                     c.close()
+            for peer in peers:
+                self._session_done(peer)
 
         report.seconds = time.perf_counter() - t0
         self.last_multi_report = report
         return report
 
     def _use_device(self, n_union: int) -> bool:
+        if jaxenv.device_failed():
+            return False  # sticky CPU fallback after a device failure
         return self._device == "tpu" or (
             self._device == "auto" and n_union >= _DEVICE_THRESHOLD
         )
@@ -601,12 +1168,15 @@ class SyncManager:
         use_device: bool,
     ) -> list[bytes]:
         if use_device:
-            from merklekv_tpu.utils.jaxenv import ensure_platform
+            try:
+                from merklekv_tpu.utils.jaxenv import ensure_platform
 
-            ensure_platform()
-            from merklekv_tpu.merkle.diff import diff_keys_pair
+                ensure_platform()
+                from merklekv_tpu.merkle.diff import diff_keys_pair
 
-            return diff_keys_pair(local_hashes, remote_hashes)
+                return diff_keys_pair(local_hashes, remote_hashes)
+            except Exception as e:
+                jaxenv.note_device_failure(e, "pairwise diff")
         keys = set(local_hashes) | set(remote_hashes)
         return sorted(
             k for k in keys if local_hashes.get(k) != remote_hashes.get(k)
@@ -692,10 +1262,16 @@ class SyncManager:
                     host, _, port = peer.rpartition(":")
                     try:
                         self.sync_once(host, int(port))
-                    except Exception:
-                        # Peer down: anti-entropy retries next round; failure
-                        # detection surfaces through last_report staleness.
+                    except Exception as e:
+                        # Peer down or stream interrupted: any checkpointed
+                        # session resumes next round, the health table
+                        # carries the degradation, and the loop moves on.
+                        # Only degrade here if the cycle didn't already —
+                        # a second mark would double-count the metric and
+                        # bury the specific reason under this generic one.
                         get_metrics().inc("anti_entropy.loop_errors")
+                        if peer not in self._degraded_this_cycle:
+                            self._degrade(peer, f"sync cycle failed: {e!r}")
                         continue
 
         self._stop.clear()

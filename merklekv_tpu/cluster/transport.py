@@ -24,6 +24,7 @@ import threading
 import time
 from collections import deque
 
+from merklekv_tpu.cluster.retry import TRANSPORT_HEAL, RetryPolicy
 from merklekv_tpu.utils.tracing import get_metrics
 from typing import Callable, Optional, Protocol
 
@@ -292,25 +293,45 @@ def _drain_outbox(t) -> None:
             return
 
 
+def _heal_policy(t) -> RetryPolicy:
+    """The transport's heal backoff as a RetryPolicy. Tests pin instance
+    ``_BACKOFF_FIRST``/``_BACKOFF_MAX`` to stagger heal races — those
+    legacy knobs keep winning over the shared policy's endpoints."""
+    policy = getattr(t, "_policy", TRANSPORT_HEAL)
+    return policy.with_overrides(
+        first_delay=t._BACKOFF_FIRST, max_delay=t._BACKOFF_MAX
+    )
+
+
+def _dead_socket() -> socket.socket:
+    """Placeholder for a link that is down from birth (broker not up yet):
+    already closed, so the reader's first recv fails straight into the
+    heal loop instead of blocking."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.close()
+    return sock
+
+
 def _heal_link(t, dial, on_connected=None) -> bool:
     """Shared reconnect engine for broker-client transports.
 
     ``t`` exposes ``_closed``, ``_send_mu``, ``_sock``, ``reconnects``, and
-    the ``_BACKOFF_FIRST``/``_BACKOFF_MAX`` policy; ``dial()`` returns a
-    fresh connected socket or raises OSError; ``on_connected`` runs after
-    the swap (e.g. MQTT resubscribe). Returns False when ``close()`` ended
-    the transport.
+    a backoff policy (``_heal_policy``); ``dial()`` returns a fresh
+    connected socket or raises OSError; ``on_connected`` runs after the
+    swap (e.g. MQTT resubscribe). Returns False when ``close()`` ended the
+    transport.
     """
     t.link_down = True
-    delay = t._BACKOFF_FIRST
+    policy = _heal_policy(t)
+    attempt = 0
     while not t._closed:
-        time.sleep(delay)
+        time.sleep(policy.backoff(attempt, getattr(t, "_heal_rng", None)))
+        attempt += 1
         if t._closed:
             return False
         try:
             sock = dial()
         except OSError:
-            delay = min(delay * 2, t._BACKOFF_MAX)
             continue
         # Unblock any publisher stuck in sendall() on the dead socket
         # BEFORE taking _send_mu: without a send timeout that sendall only
@@ -351,17 +372,22 @@ class TcpTransport:
     a detected outage wait in a bounded outbox and flush after the heal
     (only the narrow undetected-death window is lossy; anti-entropy
     repairs that residue). ``reconnects`` / ``outbox_dropped`` count the
-    healed outages and overflow drops for observability."""
+    healed outages and overflow drops for observability.
 
-    # Backoff: first retry almost immediately (broker restarts are usually
-    # fast), cap well below the anti-entropy interval so the fabric heals
-    # before the repair loop has to.
-    _BACKOFF_FIRST = 0.2
-    _BACKOFF_MAX = 5.0
+    A broker that is down at CONSTRUCTION time is the same outage one
+    second early: the transport starts with ``link_down=True``, queues
+    publishes in the outbox, and the reader's heal loop dials with the
+    same backoff — so nodes and broker can start in any order."""
+
+    # Heal backoff (shared cluster policy, cluster/retry.py). The legacy
+    # _BACKOFF_FIRST/_BACKOFF_MAX knobs derive from it and remain the
+    # per-instance override hook tests use to stagger heal races.
+    _policy = TRANSPORT_HEAL
+    _BACKOFF_FIRST = TRANSPORT_HEAL.first_delay
+    _BACKOFF_MAX = TRANSPORT_HEAL.max_delay
 
     def __init__(self, host: str, port: int, timeout: float = 5.0) -> None:
         self._host, self._port, self._timeout = host, port, timeout
-        self._sock = self._connect()
         self._subs: list[tuple[str, Callback]] = []
         self._mu = threading.Lock()
         self._send_mu = threading.Lock()
@@ -372,6 +398,14 @@ class TcpTransport:
         self._outbox_mu = threading.Lock()
         self.outbox_dropped = 0
         self.link_down = False
+        try:
+            self._sock = self._connect()
+        except OSError:
+            # Broker not up yet: start degraded and let the reader's heal
+            # loop keep dialing — startup ordering is not a requirement.
+            get_metrics().inc("transport.start_degraded")
+            self._sock = _dead_socket()
+            self.link_down = True
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 

@@ -27,12 +27,15 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from merklekv_tpu.cluster.retry import TRANSPORT_HEAL
 from merklekv_tpu.cluster.transport import (
+    _dead_socket,
     _drain_outbox,
     _enable_tcp_keepalive,
     _heal_link,
     _publish_or_queue,
 )
+from merklekv_tpu.utils.tracing import get_metrics
 
 __all__ = ["MqttTransport", "MqttBroker", "StubMqttBroker"]
 
@@ -71,10 +74,21 @@ def _utf8(s: str) -> bytes:
 
 
 def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Exactly ``n`` bytes, or None on EOF/error.
+
+    A recv DEADLINE is not EOF: an idle-but-healthy link (slow broker,
+    PINGRESP delayed under load) raises ``socket.timeout`` to the caller
+    when nothing has been read yet — the caller decides whether the quiet
+    crossed the missed-PINGRESP deadline. A timeout MID-read returns None:
+    the stream is torn between frames and only a teardown realigns it."""
     buf = b""
     while len(buf) < n:
         try:
             chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if buf:
+                return None
+            raise
         except OSError:
             return None
         if not chunk:
@@ -84,23 +98,29 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def _read_packet(sock: socket.socket) -> Optional[tuple[int, bytes]]:
-    """One MQTT control packet -> (fixed header byte, payload bytes)."""
-    head = _read_exact(sock, 1)
+    """One MQTT control packet -> (fixed header byte, payload bytes).
+
+    Raises ``socket.timeout`` only while waiting for a packet to START
+    (idle link); a stall mid-packet returns None (stream misaligned)."""
+    head = _read_exact(sock, 1)  # socket.timeout here = idle, propagate
     if head is None:
         return None
-    # Remaining Length: up to 4 varint bytes.
-    mult, length = 1, 0
-    for _ in range(4):
-        b = _read_exact(sock, 1)
-        if b is None:
-            return None
-        length += (b[0] & 0x7F) * mult
-        if not (b[0] & 0x80):
-            break
-        mult *= 128
-    else:
-        return None  # malformed varint
-    body = _read_exact(sock, length) if length else b""
+    try:
+        # Remaining Length: up to 4 varint bytes.
+        mult, length = 1, 0
+        for _ in range(4):
+            b = _read_exact(sock, 1)
+            if b is None:
+                return None
+            length += (b[0] & 0x7F) * mult
+            if not (b[0] & 0x80):
+                break
+            mult *= 128
+        else:
+            return None  # malformed varint
+        body = _read_exact(sock, length) if length else b""
+    except socket.timeout:
+        return None  # stalled mid-packet: only a reconnect realigns
     if body is None:
         return None
     return head[0], body
@@ -126,10 +146,12 @@ def _topic_matches(filt: str, topic: str) -> bool:
 class MqttTransport:
     """Transport (transport.py Protocol) over MQTT 3.1.1, QoS-0."""
 
-    # Same backoff policy as TcpTransport (transport.py): first retry
-    # almost immediately, cap below the anti-entropy interval.
-    _BACKOFF_FIRST = 0.2
-    _BACKOFF_MAX = 5.0
+    # Same heal policy as TcpTransport (cluster/retry.py): first retry
+    # almost immediately, cap below the anti-entropy interval. The legacy
+    # knobs stay as the per-instance test override hook.
+    _policy = TRANSPORT_HEAL
+    _BACKOFF_FIRST = TRANSPORT_HEAL.first_delay
+    _BACKOFF_MAX = TRANSPORT_HEAL.max_delay
 
     def __init__(
         self,
@@ -156,8 +178,18 @@ class MqttTransport:
         self.outbox_dropped = 0
         self.link_down = False
         self._packet_id = 0
+        self._last_inbound = time.monotonic()
 
-        self._sock = self._dial_and_handshake()
+        try:
+            self._sock = self._dial_and_handshake()
+        except OSError:
+            # Broker down at boot (ConnectionError from a refused CONNACK
+            # included): start degraded; the reader's heal loop dials,
+            # handshakes, and resubscribes with backoff — node-before-
+            # broker startup ordering is supported.
+            get_metrics().inc("transport.start_degraded")
+            self._sock = _dead_socket()
+            self.link_down = True
 
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -192,7 +224,10 @@ class MqttTransport:
         )
         body = var + payload
         sock.sendall(bytes([_CONNECT]) + _encode_varlen(len(body)) + body)
-        pkt = _read_packet(sock)
+        try:
+            pkt = _read_packet(sock)
+        except socket.timeout:
+            pkt = None  # no CONNACK inside the dial timeout
         if pkt is None or (pkt[0] & 0xF0) != _CONNACK:
             sock.close()
             raise ConnectionError("MQTT: no CONNACK")
@@ -200,15 +235,19 @@ class MqttTransport:
             rc = pkt[1][1] if len(pkt[1]) >= 2 else -1
             sock.close()
             raise ConnectionError(f"MQTT: connection refused rc={rc}")
-        # Read deadline = 2x keepalive: the pinger elicits a PINGRESP every
-        # keepalive/2, so a healthy link always has inbound traffic well
-        # inside the window. A silent partition (no RST — power loss, NAT
-        # drop) times the recv out instead of blocking forever, and the
-        # read loop treats that as a dead link and reconnects. keepalive=0
-        # means keepalive DISABLED per spec 3.1.2.10 — no deadline then.
+        # Recv PROBE interval, not a teardown deadline: the pinger elicits a
+        # PINGRESP every keepalive/2, so a healthy link has inbound traffic
+        # at that cadence. Each recv timeout only wakes the read loop to
+        # CHECK the missed-PINGRESP deadline (2x keepalive since the last
+        # inbound byte) — a slow-but-alive broker no longer costs a full
+        # teardown/re-handshake/resubscribe per quiet spell, while a silent
+        # partition (no RST — power loss, NAT drop) is still detected and
+        # reconnected within ~2x keepalive. keepalive=0 means keepalive
+        # DISABLED per spec 3.1.2.10 — no deadline then.
         sock.settimeout(
-            max(2.0 * self._keepalive, 1.0) if self._keepalive else None
+            max(self._keepalive / 2.0, 1.0) if self._keepalive else None
         )
+        self._last_inbound = time.monotonic()
         return sock
 
     def _reconnect(self) -> bool:
@@ -310,11 +349,27 @@ class MqttTransport:
 
     def _read_loop(self) -> None:
         while not self._closed:
-            pkt = _read_packet(self._sock)
+            try:
+                pkt = _read_packet(self._sock)
+            except socket.timeout:
+                # Quiet link, not a condemned one: only reconnect once the
+                # missed-PINGRESP deadline (2x keepalive without ANY
+                # inbound byte) has passed — a healthy-but-slow broker just
+                # waits for the next PINGRESP instead of paying a teardown.
+                if self._keepalive and (
+                    time.monotonic() - self._last_inbound
+                    > 2.0 * self._keepalive
+                ):
+                    get_metrics().inc("transport.pingresp_misses")
+                    pkt = None  # condemned: fall through to reconnect
+                else:
+                    get_metrics().inc("transport.slow_broker_waits")
+                    continue
             if pkt is None:
                 if self._closed or not self._reconnect():
                     return
                 continue
+            self._last_inbound = time.monotonic()
             header, body = pkt
             ptype = header & 0xF0
             if ptype != _PUBLISH:
@@ -355,8 +410,10 @@ class MqttBroker:
         self._listener.listen(64)
         self.host, self.port = self._listener.getsockname()
         self._mu = threading.Lock()
-        # cid -> (socket, send lock, [topic filters])
-        self._clients: dict[int, tuple[socket.socket, threading.Lock, list]] = {}
+        # cid -> (socket, send lock, [topic filters], {in-flight QoS-2 pids})
+        self._clients: dict[
+            int, tuple[socket.socket, threading.Lock, list, set]
+        ] = {}
         self._next = 0
         self._closed = False
         self.connects = 0
@@ -373,7 +430,7 @@ class MqttBroker:
             with self._mu:
                 cid = self._next
                 self._next += 1
-                self._clients[cid] = (sock, threading.Lock(), [])
+                self._clients[cid] = (sock, threading.Lock(), [], set())
             threading.Thread(
                 target=self._serve, args=(cid, sock), daemon=True
             ).start()
@@ -432,7 +489,19 @@ class MqttBroker:
                 if qos == 1:
                     self._send(cid, bytes([_PUBACK, 2]) + pid_bytes)
                 else:  # QoS 2: PUBREC now, PUBCOMP on the sender's PUBREL
+                    # Exactly-once inbound half: a DUP re-send of a packet
+                    # id still in flight (the sender lost our PUBREC) must
+                    # be re-acked but NOT fanned out twice. The pid clears
+                    # on PUBREL, freeing it for reuse per spec.
+                    (pid,) = struct.unpack(">H", pid_bytes)
+                    with self._mu:
+                        entry = self._clients.get(cid)
+                        dup = entry is not None and pid in entry[3]
+                        if entry is not None:
+                            entry[3].add(pid)
                     self._send(cid, bytes([_PUBREC, 2]) + pid_bytes)
+                    if dup:
+                        return True  # already fanned out on first receipt
             out_body = (
                 body if not qos else body[: 2 + tlen] + body[payload_off:]
             )
@@ -441,10 +510,16 @@ class MqttBroker:
             )
             with self._mu:
                 targets = list(self._clients.items())
-            for tid, (_s, _lk, filters) in targets:
+            for tid, (_s, _lk, filters, _pids) in targets:
                 if any(_topic_matches(f, topic) for f in filters):
                     self._send(tid, frame)
         elif ptype == _PUBREL & 0xF0:
+            if len(body) >= 2:
+                (pid,) = struct.unpack(">H", body[:2])
+                with self._mu:
+                    entry = self._clients.get(cid)
+                    if entry is not None:
+                        entry[3].discard(pid)
             self._send(cid, bytes([_PUBCOMP, 2]) + body[:2])
         elif ptype == _PINGREQ & 0xF0:
             self._send(cid, bytes([_PINGRESP, 0]))
@@ -457,7 +532,7 @@ class MqttBroker:
             entry = self._clients.get(cid)
         if entry is None:
             return
-        sock, lock, _ = entry
+        sock, lock = entry[0], entry[1]
         try:
             with lock:
                 sock.sendall(frame)
@@ -488,7 +563,7 @@ class MqttBroker:
         with self._mu:
             entries = list(self._clients.values())
             self._clients.clear()
-        for s, _lk, _f in entries:
+        for s, *_rest in entries:
             try:
                 s.shutdown(socket.SHUT_RDWR)
             except OSError:

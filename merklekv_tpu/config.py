@@ -10,9 +10,111 @@ uses stdlib tomllib — no third-party config crate needed.
 from __future__ import annotations
 
 import os
-import tomllib
 from dataclasses import dataclass, field
 from typing import Optional
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:  # pragma: no cover - depends on interpreter
+    tomllib = None
+
+
+def _parse_toml_value(text: str):
+    text = text.strip()
+    if text.startswith("[") and text.endswith("]"):
+        inner = text[1:-1].strip()
+        if not inner:
+            return []
+        # Split on top-level commas (strings in our configs never contain
+        # commas, but guard quoted segments anyway).
+        items, depth, quote, cur = [], 0, "", ""
+        for ch in inner:
+            if quote:
+                cur += ch
+                if ch == quote:
+                    quote = ""
+                continue
+            if ch in "\"'":
+                quote = ch
+                cur += ch
+            elif ch == "[":
+                depth += 1
+                cur += ch
+            elif ch == "]":
+                depth -= 1
+                cur += ch
+            elif ch == "," and depth == 0:
+                items.append(cur)
+                cur = ""
+            else:
+                cur += ch
+        if cur.strip():
+            items.append(cur)
+        return [_parse_toml_value(i) for i in items]
+    if (text.startswith('"') and text.endswith('"')) or (
+        text.startswith("'") and text.endswith("'")
+    ):
+        return text[1:-1]
+    if text == "true":
+        return True
+    if text == "false":
+        return False
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    return text  # bare string (lenient; our schema coerces with str())
+
+
+def _minitoml_loads(text: str) -> dict:
+    """Fallback parser for the TOML subset this schema uses (scalar keys,
+    [section] tables, single-line arrays, # comments) — Python 3.10 has no
+    stdlib tomllib and this environment must not grow dependencies."""
+    root: dict = {}
+    table = root
+    for raw_line in text.splitlines():
+        line = raw_line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            # "[section]  # comment" is valid TOML; section names in this
+            # schema never contain '#', so a plain split is safe here.
+            head = line.split("#", 1)[0].strip()
+            if not head.endswith("]"):
+                raise ValueError(f"malformed TOML line: {raw_line!r}")
+            table = root
+            for part in head[1:-1].strip().split("."):
+                table = table.setdefault(part.strip(), {})
+            continue
+        key, sep, value = line.partition("=")
+        if not sep:
+            raise ValueError(f"malformed TOML line: {raw_line!r}")
+        # Strip trailing comments outside quotes.
+        out, quote = "", ""
+        for ch in value:
+            if quote:
+                out += ch
+                if ch == quote:
+                    quote = ""
+            elif ch in "\"'":
+                quote = ch
+                out += ch
+            elif ch == "#":
+                break
+            else:
+                out += ch
+        table[key.strip().strip('"').strip("'")] = _parse_toml_value(out)
+    return root
+
+
+def _toml_load(f) -> dict:
+    if tomllib is not None:
+        return tomllib.load(f)
+    return _minitoml_loads(f.read().decode("utf-8"))
 
 
 @dataclass
@@ -72,7 +174,7 @@ class Config:
     @classmethod
     def load(cls, path: str) -> "Config":
         with open(path, "rb") as f:
-            raw = tomllib.load(f)
+            raw = _toml_load(f)
         return cls.from_dict(raw)
 
     @classmethod

@@ -260,6 +260,75 @@ std::vector<std::string> MemEngine::scan(const std::string& prefix) {
   return out;
 }
 
+std::vector<std::pair<std::string, bool>> Engine::page_after(
+    const std::string& after, size_t limit) {
+  // Generic fallback: merge the two sorted exports. Correct for any
+  // engine, but O(N log N) per page — engines with direct access to their
+  // storage should override (MemEngine below).
+  auto keys = scan("");
+  auto tombs = tombstones("");
+  std::vector<std::pair<std::string, bool>> out;
+  size_t i = 0, j = 0;
+  while (i < keys.size() && keys[i] <= after) ++i;
+  while (j < tombs.size() && tombs[j].first <= after) ++j;
+  while (out.size() < limit && (i < keys.size() || j < tombs.size())) {
+    bool take_live =
+        i < keys.size() && (j >= tombs.size() || keys[i] <= tombs[j].first);
+    if (take_live) {
+      // scan() and tombstones() are two separate reads, so a racing
+      // delete can land a key in both; keep the live row (the caller
+      // re-reads atomically) WITHOUT shortening the page — a short page
+      // signals keyspace exhaustion to the walker.
+      if (j < tombs.size() && tombs[j].first == keys[i]) ++j;
+      out.emplace_back(std::move(keys[i]), false);
+      ++i;
+    } else {
+      out.emplace_back(std::move(tombs[j].first), true);
+      ++j;
+    }
+  }
+  return out;
+}
+
+std::vector<std::pair<std::string, bool>> MemEngine::page_after(
+    const std::string& after, size_t limit) {
+  // Bounded top-k selection: the `limit` smallest keys strictly after the
+  // cursor via a max-heap, O(N log limit) per page with no full-keyspace
+  // vector or sort — a paged anti-entropy walk over N keys costs
+  // O(N^2/page * log page) comparisons instead of O(N^2/page * log N)
+  // plus a whole-keyspace copy per page. Within a shard the live map and
+  // tombstone map are disjoint (a set erases its tombstone under the same
+  // lock), and both are read under one shared_lock here, so no key can
+  // appear twice and the page never comes up short while keys remain.
+  using Row = std::pair<std::string, bool>;  // (key, is_tombstone)
+  auto by_key = [](const Row& a, const Row& b) { return a.first < b.first; };
+  std::vector<Row> heap;
+  heap.reserve(limit + 1);
+  auto offer = [&](const std::string& k, bool tomb) {
+    if (k <= after) return;
+    if (heap.size() == limit && heap.front().first <= k) return;
+    heap.emplace_back(k, tomb);
+    std::push_heap(heap.begin(), heap.end(), by_key);
+    if (heap.size() > limit) {
+      std::pop_heap(heap.begin(), heap.end(), by_key);
+      heap.pop_back();
+    }
+  };
+  for (Shard& s : shards_) {
+    std::shared_lock lk(s.mu);
+    for (const auto& [k, e] : s.map) {
+      (void)e;
+      offer(k, false);
+    }
+    for (const auto& [k, ts] : s.tombs) {
+      (void)ts;
+      offer(k, true);
+    }
+  }
+  std::sort_heap(heap.begin(), heap.end(), by_key);
+  return heap;
+}
+
 size_t MemEngine::dbsize() {
   size_t n = 0;
   for (Shard& s : shards_) {

@@ -90,6 +90,15 @@ class Engine {
   virtual bool exists(const std::string& key) = 0;
   // Sorted keys with the given prefix ("" = all).
   virtual std::vector<std::string> scan(const std::string& prefix) = 0;
+  // Up to `limit` (key, is_tombstone) rows for keys STRICTLY after the
+  // cursor, live keys and tombstones merged in one sorted stream — the
+  // HASHPAGE unit of resumable anti-entropy. Fewer rows than `limit`
+  // means the keyspace past the cursor is exhausted, so implementations
+  // must not drop rows mid-page. Base implementation pages over
+  // scan()+tombstones(); MemEngine overrides with a bounded top-k
+  // selection so a paged walk does not sort the whole keyspace per page.
+  virtual std::vector<std::pair<std::string, bool>> page_after(
+      const std::string& after, size_t limit);
   virtual size_t dbsize() = 0;
   virtual size_t memory_usage() = 0;  // bytes (keys + values)
   // Missing key counts as 0 (reference rwlock_engine.rs:252-320); non-numeric
@@ -142,6 +151,8 @@ class MemEngine : public Engine {
   std::vector<std::pair<std::string, uint64_t>> key_timestamps() override;
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
+  std::vector<std::pair<std::string, bool>> page_after(
+      const std::string& after, size_t limit) override;
   size_t dbsize() override;
   size_t memory_usage() override;
   Result<int64_t> increment(const std::string& key, int64_t amount) override;
@@ -215,6 +226,10 @@ class LogEngine : public Engine {
   }
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
+  std::vector<std::pair<std::string, bool>> page_after(
+      const std::string& after, size_t limit) override {
+    return mem_.page_after(after, limit);
+  }
   size_t dbsize() override;
   size_t memory_usage() override;
   Result<int64_t> increment(const std::string& key, int64_t amount) override;

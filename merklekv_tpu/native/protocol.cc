@@ -166,7 +166,8 @@ ParseResult parse_command(const std::string& line) {
     std::string u = upper(input);
     Command c;
     if (u == "GET" || u == "SET" || u == "DELETE" || u == "DEL" ||
-        u == "ECHO" || u == "EXISTS" || u == "SYNC" || u == "REPLICATE") {
+        u == "ECHO" || u == "EXISTS" || u == "SYNC" || u == "REPLICATE" ||
+        u == "HASHPAGE") {
       return err(u + " command requires arguments");
     }
     if (u == "TRUNCATE") { c.verb = Verb::Truncate; return ok(std::move(c)); }
@@ -343,6 +344,27 @@ ParseResult parse_command(const std::string& line) {
     Command c;
     c.verb = Verb::LeafHashes;
     c.prefix = rest;
+    return ok(std::move(c));
+  }
+  if (u == "HASHPAGE") {
+    // "HASHPAGE <count> [<after>]" — the paged form of LEAFHASHES. The
+    // cursor is a key (exclusive); keys cannot contain spaces, so plain
+    // whitespace splitting is unambiguous.
+    auto toks = split_ws(rest);
+    if (toks.empty() || toks.size() > 2) {
+      return err("HASHPAGE requires arguments: <count> [<after>]");
+    }
+    int64_t count;
+    if (!parse_i64_str(toks[0], &count) || count <= 0) {
+      return err("HASHPAGE count must be a positive integer");
+    }
+    Command c;
+    c.verb = Verb::HashPage;
+    c.amount = count;
+    if (toks.size() == 2) {
+      if (auto e = bad_char(toks[1], "key")) return err(*e);
+      c.prefix = toks[1];
+    }
     return ok(std::move(c));
   }
   if (u == "INC") return parse_numeric(Verb::Increment, "INC", rest);

@@ -21,6 +21,10 @@ enum class Verb {
   MultiGet, MultiSet, Truncate, Exists, Scan, Dbsize, Hash,
   LeafHashes, Stats, Info, Version, Memory, ClientList, Flushdb, Shutdown,
   Ping, Echo, Sync, Replicate,
+  // Cursor-paged LEAFHASHES: "HASHPAGE <count> [<after>]" emits up to
+  // <count> merged (live + tombstone) hash lines for keys strictly after
+  // the cursor, in sorted order — the unit of resumable anti-entropy.
+  HashPage,
   // Extension (like LEAFHASHES): per-peer health table from the cluster
   // control plane's failure detector.
   Peers,
@@ -33,11 +37,11 @@ struct Command {
   Verb verb{};
   std::string key;                 // Get/Set/Delete/Inc/Dec/Append/Prepend
   std::string value;               // Set/Append/Prepend
-  std::optional<int64_t> amount;   // Inc/Dec
+  std::optional<int64_t> amount;   // Inc/Dec; HashPage page size
   std::vector<std::string> keys;   // Exists/MultiGet
   std::vector<std::pair<std::string, std::string>> pairs;  // MultiSet
   std::string message;             // Ping/Echo
-  std::string prefix;              // Scan / LeafHashes
+  std::string prefix;              // Scan / LeafHashes; HashPage after-cursor
   std::optional<std::string> pattern;  // Hash
   std::string host;                // Sync
   uint16_t port = 0;               // Sync

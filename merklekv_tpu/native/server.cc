@@ -444,6 +444,53 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       }
       return "HASHES " + std::to_string(listed) + "\r\n" + body;
     }
+    case Verb::HashPage: {
+      // Cursor-paged LEAFHASHES: up to `count` merged (live + tombstone)
+      // lines for keys strictly after the cursor, GLOBALLY SORTED — unlike
+      // LEAFHASHES, which groups tombstones after live keys. Sorted order
+      // is what makes a page a verified key range: a peer that has applied
+      // pages up to cursor C has converged the keyspace prefix <= C and can
+      // resume from C after a dead stream instead of refetching everything.
+      // Fewer lines than requested means the keyspace is exhausted.
+      const std::string& after = cmd.prefix;
+      const int64_t want = cmd.amount.value_or(1);
+      // page_after is the engine's bounded top-k selection: O(N log page)
+      // per request instead of materializing + sorting the whole keyspace
+      // for every page of the walk (which made one full paged walk
+      // O(N^2/page) — ruinous at the 10M-key target).
+      auto rows = engine_->page_after(after, size_t(want));
+      std::string body;
+      int64_t listed = 0;
+      for (auto& [k, was_tomb] : rows) {
+        // One atomic (value, ts) read, same as LEAFHASHES: a split
+        // get + get_ts can pair a stale digest with a newer timestamp.
+        // The row's live/tombstone flag is only a hint — the key may have
+        // been set or deleted since the page was selected.
+        auto vt = engine_->get_with_ts(k);
+        if (vt) {
+          uint8_t d[32];
+          leaf_hash(k, vt->first, d);
+          body += k + " " + digest_hex(d) + " " +
+                  std::to_string(vt->second) + "\r\n";
+          ++listed;
+        } else if (auto ts = engine_->tombstone_ts(k)) {
+          // Tombstone line: the deletion ts still reaches the peer's LWW.
+          body += k + " - " + std::to_string(*ts) + "\r\n";
+          ++listed;
+        } else {
+          // Neither live nor tombstoned (deleted + tombstone evicted since
+          // page selection). Dropping the row would shorten the page, and
+          // a short page signals keyspace exhaustion to the walker — which
+          // would then quiet-delete every local key past the cursor. Emit
+          // the ts-0 sentinel instead: "state unknown, skip this key";
+          // walkers never adopt a ts-0 tombstone, and the key repairs on
+          // the next cycle.
+          body += k + " - 0\r\n";
+          ++listed;
+        }
+      }
+      return "HASHES " + std::to_string(listed) + "\r\n" + body;
+    }
     case Verb::Truncate:
     case Verb::Flushdb: {
       // FLUSHDB truncates, like the reference (server.rs:901-908).

@@ -91,7 +91,8 @@ struct ServerStats {
       case Verb::Metrics: management_commands++; break;
       case Verb::Sync: sync_commands++; break;
       case Verb::Hash:
-      case Verb::LeafHashes: hash_commands++; break;
+      case Verb::LeafHashes:
+      case Verb::HashPage: hash_commands++; break;
       case Verb::Replicate: replicate_commands++; break;
     }
   }

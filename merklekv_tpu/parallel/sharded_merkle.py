@@ -21,7 +21,21 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map as _shard_map_impl
+
+    _REPLICATION_CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _REPLICATION_CHECK_KW = "check_rep"
+
+
+def shard_map(*args, **kwargs):
+    if "check_vma" in kwargs and _REPLICATION_CHECK_KW != "check_vma":
+        kwargs[_REPLICATION_CHECK_KW] = kwargs.pop("check_vma")
+    return _shard_map_impl(*args, **kwargs)
 
 from merklekv_tpu.merkle.diff import divergence_masks, divergence_vs_ref
 from merklekv_tpu.ops.dispatch import build_levels, hash_blocks, use_pallas

@@ -1,0 +1,6 @@
+"""Test-support infrastructure shipped with the package.
+
+``merklekv_tpu.testing.faults`` is the fault-injection layer the chaos
+suite (tests/test_faults.py) drives; it lives in the package, not under
+tests/, so downstream deployments can chaos-test their own topologies.
+"""

@@ -1,0 +1,423 @@
+"""Fault injection: create the adversarial delivery model, deterministically.
+
+The anti-entropy engine claims to converge under dropped, delayed,
+duplicated, and reordered traffic and under peers dying mid-sync.
+"Asynchronous Merkle Trees" (PAPERS.md) makes the methodological point:
+such claims are only arguments until the adversary can be CONSTRUCTED in a
+test. This module constructs it, at two layers:
+
+- :class:`FaultInjector` — a TCP proxy in front of any server/broker port.
+  Faults act per forwarded chunk, per direction, driven by a seeded RNG so
+  every chaos run replays bit-identically. Byte streams get the faults TCP
+  can actually exhibit to an application: arbitrary delay, reordering
+  across socket boundaries, duplicated/truncated delivery from a broken
+  middlebox, and death (a lost segment never surfaces as a silent gap —
+  the connection dies; ``drop`` therefore kills the stream after
+  discarding, which is exactly the failure anti-entropy must resume
+  through).
+- :class:`FaultyTransport` — a message-level wrapper over any
+  ``Transport`` (cluster/transport.py). The event fabric is QoS-0
+  datagram-like, so whole-message drop/duplicate/reorder/delay are the
+  meaningful faults there; LWW + op-id dedupe + anti-entropy must absorb
+  them.
+- :class:`PeerProcessKiller` — SIGKILL a spawned server process at a
+  controlled moment (the process-level peer killer for the integration
+  suite).
+
+Nothing here is imported by serving code; it costs nothing in production.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Optional
+
+__all__ = ["FaultSpec", "FaultInjector", "FaultyTransport", "PeerProcessKiller"]
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Per-direction fault probabilities/parameters (all default off)."""
+
+    # Discard the chunk AND kill the connection: TCP never delivers a
+    # silent gap, so a lost segment surfaces to the app as a dead link.
+    drop_rate: float = 0.0
+    # Uniform per-chunk forwarding delay (seconds): (min, max).
+    delay: tuple[float, float] = (0.0, 0.0)
+    # Hold the chunk and release it AFTER the next one (pairwise swap).
+    reorder_rate: float = 0.0
+    # Forward the chunk twice (broken middlebox / at-least-once fabric).
+    dup_rate: float = 0.0
+    # Forward only a prefix of the chunk, then kill the connection.
+    truncate_rate: float = 0.0
+    # Forward the chunk intact, then kill the connection.
+    close_rate: float = 0.0
+
+
+class FaultInjector:
+    """Deterministic fault-injecting TCP proxy.
+
+        inj = FaultInjector("127.0.0.1", server_port, seed=7)
+        client = MerkleKVClient(inj.host, inj.port)
+        inj.set_faults("s2c", drop_rate=0.3)
+
+    Directions: ``"c2s"`` (client->server), ``"s2c"`` (server->client),
+    ``"both"``. Each (connection, direction) derives its own RNG from the
+    injector seed and the connection ordinal, so a fixed seed replays the
+    same fault schedule regardless of thread timing.
+
+    ``kill_after_bytes(n, direction)`` arms a deterministic peer death:
+    once ``n`` payload bytes have been forwarded in that direction the
+    proxied "peer" dies — every live connection is reset and new dials are
+    refused until :meth:`revive`. This is how the chaos suite kills a peer
+    mid-sync at a reproducible point in the repair stream.
+    """
+
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        seed: int = 0,
+        listen_host: str = "127.0.0.1",
+        chunk_size: int = 4096,
+    ) -> None:
+        self._upstream = (upstream_host, upstream_port)
+        self._seed = seed
+        self._chunk = chunk_size
+        self._specs = {"c2s": FaultSpec(), "s2c": FaultSpec()}
+        self._mu = threading.Lock()
+        self._conns: dict[int, tuple[socket.socket, socket.socket]] = {}
+        self._next_cid = 0
+        self._closed = False
+        self._dead = False  # peer "dead": refuse dials, reset live conns
+        self._kill_budget: dict[str, Optional[int]] = {"c2s": None, "s2c": None}
+        self._forwarded: dict[str, int] = {"c2s": 0, "s2c": 0}
+        # Observability for assertions.
+        self.connections = 0
+        self.chunks_forwarded = 0
+        self.chunks_dropped = 0
+        self.chunks_duplicated = 0
+        self.chunks_reordered = 0
+        self.chunks_truncated = 0
+        self.kills = 0
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, 0))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    # -- configuration --------------------------------------------------------
+    def set_faults(self, direction: str = "both", **fields) -> None:
+        """Replace fault parameters for a direction (unset fields reset to
+        the FaultSpec default — a call describes the COMPLETE fault state,
+        so scenarios compose explicitly, not accidentally)."""
+        for d in self._dirs(direction):
+            self._specs[d] = replace(FaultSpec(), **fields)
+
+    def clear_faults(self) -> None:
+        self._specs = {"c2s": FaultSpec(), "s2c": FaultSpec()}
+
+    def kill_after_bytes(self, n: int, direction: str = "s2c") -> None:
+        """Arm a deterministic peer death after ``n`` forwarded bytes."""
+        for d in self._dirs(direction):
+            self._kill_budget[d] = n
+
+    def kill_peer(self) -> None:
+        """The proxied peer dies NOW: reset every connection, refuse dials."""
+        self._dead = True
+        self.kills += 1
+        self._reset_conns()
+
+    def revive(self) -> None:
+        """The peer is back (restart): accept dials again."""
+        self._dead = False
+        self._kill_budget = {"c2s": None, "s2c": None}
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    # -- proxy machinery ------------------------------------------------------
+    @staticmethod
+    def _dirs(direction: str) -> list[str]:
+        if direction == "both":
+            return ["c2s", "s2c"]
+        if direction not in ("c2s", "s2c"):
+            raise ValueError(f"unknown direction {direction!r}")
+        return [direction]
+
+    def _accept(self) -> None:
+        while not self._closed:
+            try:
+                downstream, _ = self._listener.accept()
+            except OSError:
+                return
+            if self._dead or self._closed:
+                self._hard_close(downstream)
+                continue
+            try:
+                upstream = socket.create_connection(self._upstream, timeout=5)
+            except OSError:
+                self._hard_close(downstream)
+                continue
+            for s in (downstream, upstream):
+                try:
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+            with self._mu:
+                cid = self._next_cid
+                self._next_cid += 1
+                self._conns[cid] = (downstream, upstream)
+                self.connections += 1
+            for direction, src, dst in (
+                ("c2s", downstream, upstream),
+                ("s2c", upstream, downstream),
+            ):
+                rng = random.Random(
+                    (self._seed * 1_000_003 + cid * 2)
+                    ^ (1 if direction == "s2c" else 0)
+                )
+                threading.Thread(
+                    target=self._pump,
+                    args=(cid, src, dst, direction, rng),
+                    daemon=True,
+                ).start()
+
+    def _pump(
+        self,
+        cid: int,
+        src: socket.socket,
+        dst: socket.socket,
+        direction: str,
+        rng: random.Random,
+    ) -> None:
+        held: Optional[bytes] = None  # chunk delayed for a pairwise swap
+        try:
+            while not self._closed:
+                try:
+                    data = src.recv(self._chunk)
+                except OSError:
+                    break
+                if not data:
+                    break
+                spec = self._specs[direction]
+                budget = self._kill_budget[direction]
+                if budget is not None and self._forwarded[direction] >= budget:
+                    self.kill_peer()
+                    break
+                if spec.drop_rate and rng.random() < spec.drop_rate:
+                    # A lost TCP segment is a dead link, never a silent gap.
+                    self.chunks_dropped += 1
+                    break
+                if spec.truncate_rate and rng.random() < spec.truncate_rate:
+                    self.chunks_truncated += 1
+                    self._send(dst, data[: max(1, len(data) // 2)], direction)
+                    break
+                d_lo, d_hi = spec.delay
+                if d_hi > 0:
+                    time.sleep(rng.uniform(d_lo, d_hi))
+                if held is not None:
+                    # Release order: current chunk first, held chunk second.
+                    if not self._send(dst, data, direction):
+                        break
+                    ok = self._send(dst, held, direction)
+                    held = None
+                    if not ok:
+                        break
+                    self.chunks_forwarded += 2
+                    continue
+                if spec.reorder_rate and rng.random() < spec.reorder_rate:
+                    self.chunks_reordered += 1
+                    held = data
+                    continue
+                if not self._send(dst, data, direction):
+                    break
+                self.chunks_forwarded += 1
+                if spec.dup_rate and rng.random() < spec.dup_rate:
+                    self.chunks_duplicated += 1
+                    if not self._send(dst, data, direction):
+                        break
+                if spec.close_rate and rng.random() < spec.close_rate:
+                    break
+        finally:
+            if held is not None:
+                self._send(dst, held, direction)
+            self._drop(cid)
+
+    def _send(self, dst: socket.socket, data: bytes, direction: str) -> bool:
+        try:
+            dst.sendall(data)
+        except OSError:
+            return False
+        self._forwarded[direction] += len(data)
+        return True
+
+    @staticmethod
+    def _hard_close(sock: socket.socket) -> None:
+        # RST, not FIN: a killed peer does not say goodbye.
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+            )
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _drop(self, cid: int) -> None:
+        with self._mu:
+            pair = self._conns.pop(cid, None)
+        if pair is not None:
+            for s in pair:
+                self._hard_close(s)
+
+    def _reset_conns(self) -> None:
+        with self._mu:
+            pairs = list(self._conns.values())
+            self._conns.clear()
+        for a, b in pairs:
+            self._hard_close(a)
+            self._hard_close(b)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._reset_conns()
+
+
+class FaultyTransport:
+    """Message-level fault wrapper implementing the ``Transport`` protocol.
+
+    Wraps any inner transport and applies whole-message faults on
+    ``publish`` — the QoS-0 event fabric's failure model. Deterministic
+    under a fixed seed. Delivery-side faults are not needed: publishing
+    through a wrapped transport exercises every subscriber identically.
+    """
+
+    def __init__(
+        self,
+        inner,
+        seed: int = 0,
+        drop_rate: float = 0.0,
+        dup_rate: float = 0.0,
+        reorder_rate: float = 0.0,
+        delay: tuple[float, float] = (0.0, 0.0),
+    ) -> None:
+        self._inner = inner
+        self._rng = random.Random(seed)
+        self._drop = drop_rate
+        self._dup = dup_rate
+        self._reorder = reorder_rate
+        self._delay = delay
+        self._held: Optional[tuple[str, bytes]] = None
+        self._mu = threading.Lock()
+        self.published = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self.reordered = 0
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._mu:
+            if self._drop and self._rng.random() < self._drop:
+                self.dropped += 1
+                return
+            d_lo, d_hi = self._delay
+            if d_hi > 0:
+                time.sleep(self._rng.uniform(d_lo, d_hi))
+            held, self._held = self._held, None
+            if held is None and self._reorder and (
+                self._rng.random() < self._reorder
+            ):
+                self.reordered += 1
+                self._held = (topic, payload)
+                return
+            self._inner.publish(topic, payload)
+            self.published += 1
+            if held is not None:
+                self._inner.publish(*held)
+                self.published += 1
+            if self._dup and self._rng.random() < self._dup:
+                self.duplicated += 1
+                self._inner.publish(topic, payload)
+
+    def flush_held(self) -> None:
+        """Release a message held for reordering (end-of-scenario drain)."""
+        with self._mu:
+            held, self._held = self._held, None
+        if held is not None:
+            self._inner.publish(*held)
+            self.published += 1
+
+    def subscribe(self, topic_prefix: str, callback) -> None:
+        self._inner.subscribe(topic_prefix, callback)
+
+    def unsubscribe(self, callback) -> None:
+        self._inner.unsubscribe(callback)
+
+    def close(self) -> None:
+        self.flush_held()
+        self._inner.close()
+
+    def __getattr__(self, name):  # reconnects/outbox counters etc.
+        return getattr(self._inner, name)
+
+
+class PeerProcessKiller:
+    """SIGKILL a spawned peer server at a controlled moment.
+
+    The process-level analog of ``FaultInjector.kill_peer`` for the
+    integration suite (tests/test_integration_processes.py): no shutdown
+    path, no engine close, no flush — the death a crashed machine gives.
+    """
+
+    def __init__(self, proc: subprocess.Popen) -> None:
+        self._proc = proc
+        self.killed = False
+
+    def kill_now(self) -> None:
+        self._proc.kill()
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        self.killed = True
+
+    def kill_when(
+        self,
+        predicate: Callable[[], bool],
+        timeout: float = 30.0,
+        poll: float = 0.005,
+    ) -> bool:
+        """Kill as soon as ``predicate()`` is true; False on timeout (the
+        peer survives — callers assert on the return)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if predicate():
+                self.kill_now()
+                return True
+            time.sleep(poll)
+        return False
+
+    def kill_after(self, seconds: float) -> threading.Timer:
+        t = threading.Timer(seconds, self.kill_now)
+        t.daemon = True
+        t.start()
+        return t

@@ -11,8 +11,10 @@ Must run before the first computation initializes a backend.
 from __future__ import annotations
 
 import os
+import threading
+import warnings
 
-__all__ = ["ensure_platform"]
+__all__ = ["ensure_platform", "note_device_failure", "device_failed"]
 
 
 def ensure_platform() -> None:
@@ -25,3 +27,38 @@ def ensure_platform() -> None:
         jax.config.update("jax_platforms", plat)
     except RuntimeError:
         pass  # backend already initialized; keep whatever it is
+
+
+# -- graceful device degradation --------------------------------------------
+#
+# TPU/Pallas init can fail at runtime (chip already claimed by another
+# process, driver trouble, backend plugin missing). Serving paths must not
+# turn that into a crash loop: the first failure is recorded here, a single
+# warning is emitted, and every device-vs-CPU dispatch point checks
+# ``device_failed()`` to pin itself to the host path from then on.
+
+_device_mu = threading.Lock()
+_device_fallback = False
+
+
+def note_device_failure(err: BaseException, what: str = "device path") -> None:
+    """Record a device-path failure; warn exactly once process-wide."""
+    global _device_fallback
+    with _device_mu:
+        first = not _device_fallback
+        _device_fallback = True
+    if first:
+        warnings.warn(
+            f"JAX {what} unavailable ({err!r}); falling back to the CPU "
+            "engine for the rest of this process",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        get_metrics().inc("device.fallbacks")
+
+
+def device_failed() -> bool:
+    """True once any device path has failed; callers use the CPU engine."""
+    return _device_fallback

@@ -269,16 +269,16 @@ def test_crash_recovery_prefix_consistency(tmp_path):
         finally:
             done.set()
 
+    from merklekv_tpu.testing.faults import PeerProcessKiller
+
     t = threading.Thread(target=writer)
     t.start()
-    deadline = time.time() + 10
-    while acked < 200 and time.time() < deadline:
-        time.sleep(0.005)
-    p.kill()  # SIGKILL mid-stream: no shutdown path, no engine close
-    p.wait(timeout=10)
+    # SIGKILL mid-stream: no shutdown path, no engine close.
+    killer = PeerProcessKiller(p)
+    killed = killer.kill_when(lambda: acked >= 200, timeout=10)
     done.wait(timeout=10)
     t.join(timeout=10)
-    assert acked >= 200, f"writer only got {acked} acks before the deadline"
+    assert killed, f"writer only got {acked} acks before the deadline"
 
     p2 = _spawn(
         ["-m", "merklekv_tpu", "--port", "0", "--engine", "log",

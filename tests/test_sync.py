@@ -134,7 +134,7 @@ def test_hash_first_fetches_only_divergent_values(two_nodes):
     mgr = SyncManager(local_eng, device="cpu")
     report = mgr.sync_once("127.0.0.1", remote_srv.port)
 
-    assert report.mode == "hash-first"
+    assert report.mode == "hash-paged"
     assert report.divergent == 10
     assert report.values_fetched == 7  # ONLY divergent remote keys travel
     assert report.set_keys == 7 and report.deleted_keys == 3
