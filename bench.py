@@ -51,29 +51,38 @@ def _on_tpu() -> bool:
 
 
 def _resolve_backend() -> str:
-    """The JAX backend name, degrading to CPU instead of crashing.
+    """The JAX backend name, degrading to CPU instead of crashing/hanging.
 
     The deployment pin can point jax at a tunneled TPU that is absent or
     already claimed ("Unable to initialize backend" killed whole bench
-    runs — BENCH_r05.json); the bench must still produce its JSON contract
-    on the host path, with the backend recorded so the judge can tell a
-    degraded run from a chip run."""
+    runs — BENCH_r05.json); init can also block indefinitely on a dead
+    tunnel. So the backend is probed in a throwaway subprocess with a
+    deadline BEFORE this process imports jax: a failed/hung probe pins the
+    parent to CPU while its config is still untouched, and the JSON
+    contract survives with the degradation recorded."""
+    from merklekv_tpu.utils.jaxenv import probe_default_backend
+
+    timeout = float(os.environ.get("MKV_BENCH_PROBE_TIMEOUT", "90"))
+    probed = probe_default_backend(timeout=timeout)
+    if probed == "tpu":
+        return probed  # healthy chip: leave the parent's config untouched
+    if probed is None:
+        print("# backend probe failed or timed out; pinning this process "
+              "to cpu", file=sys.stderr)
+    # Non-TPU answer (or no answer): pin the parent too — a sitecustomize
+    # deployment pin ignores plain env vars, so only a config update makes
+    # the parent actually run where the probe said.
     import jax
 
     try:
+        jax.config.update("jax_platforms", probed or "cpu")
+    except Exception:
+        pass  # backend already initialized; report whatever it resolved to
+    try:
         return jax.default_backend()
     except Exception as e:
-        print(f"# backend init failed ({e!r}); falling back to cpu",
-              file=sys.stderr)
-        try:
-            jax.config.update("jax_platforms", "cpu")
-            return jax.default_backend()
-        except Exception as e2:  # config already frozen mid-init
-            # No further recourse (env vars are not re-read after import
-            # jax); the JSON contract still holds, with the degradation
-            # recorded.
-            print(f"# cpu fallback also failed ({e2!r})", file=sys.stderr)
-            return "unavailable"
+        print(f"# cpu fallback also failed ({e!r})", file=sys.stderr)
+        return "unavailable"
 
 
 def _make_kv(n: int) -> tuple[list[bytes], list[bytes]]:
@@ -362,7 +371,37 @@ def bench_diff64(n: int, reps: int) -> dict:
 
 
 def main() -> None:
-    backend = _resolve_backend()
+    """Driver entry: ALWAYS leaves one parsable JSON record on stdout and
+    exits 0, even when no TPU backend (or no working jax at all) is
+    available — a failed run is reported through the record's "error"
+    field, not a bare rc=1 (BENCH_r05 regressed exactly that way)."""
+    try:
+        backend = _resolve_backend()
+    except Exception as e:
+        backend = "unavailable"
+        print(f"# backend resolution failed: {e!r}", file=sys.stderr)
+    try:
+        _run(backend)
+    except BaseException as e:
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "merkle_rebuild_diff_keys_per_s",
+                    "value": None,
+                    "unit": "keys/s",
+                    "error": f"{type(e).__name__}: {e}",
+                    "backend": backend,
+                }
+            )
+        )
+
+
+def _run(backend: str) -> None:
     on_tpu = backend == "tpu"
 
     # Headline sizes: the 10M north-star on the chip; smoke sizes elsewhere.

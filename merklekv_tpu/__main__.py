@@ -16,12 +16,26 @@ import time
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "walcheck":
+        # Offline tool: verify WAL frames + snapshot root stamps without a
+        # server (docs/PERSISTENCE.md "Verification").
+        from merklekv_tpu.storage.walcheck import main as walcheck_main
+
+        return walcheck_main(argv[1:])
+
     p = argparse.ArgumentParser(prog="merklekv_tpu")
     p.add_argument("--config", help="TOML config file")
     p.add_argument("--engine", help="storage engine: mem|rwlock|kv|log|sled")
-    p.add_argument("--storage-path", help="data dir for the durable engine")
+    p.add_argument("--storage-path", help="data dir for durable storage")
     p.add_argument("--host")
     p.add_argument("--port", type=int)
+    p.add_argument(
+        "--durable",
+        action="store_true",
+        help="enable the [storage] WAL+snapshot subsystem",
+    )
     args = p.parse_args(argv)
 
     from merklekv_tpu.config import load_or_default
@@ -48,12 +62,46 @@ def main(argv: list[str] | None = None) -> int:
         cfg.host = args.host
     if args.port is not None:
         cfg.port = args.port
+    if args.durable:
+        cfg.storage.enabled = True
 
     engine = NativeEngine(cfg.engine, cfg.storage_path)
+
+    # Durable subsystem. The data dir is per-port (node_data_dir) so nodes
+    # sharing a cwd-relative storage_path — the multi-node test shape —
+    # cannot collide; the directory flock rejects whatever slips past that.
+    # On a FIXED port the dir is known up front, so recovery completes
+    # before the listening socket even exists — no window where a client
+    # reads pre-recovery state or writes an un-journaled key.
+    storage = None
+    if cfg.storage.enabled:
+        from merklekv_tpu.storage import DurableStore, node_data_dir
+
+        if cfg.port != 0:
+            storage = DurableStore(
+                engine, cfg.storage, node_data_dir(cfg.storage_path, cfg.port)
+            )
+            recovery = storage.recover()
+
     server = NativeServer(
         engine, cfg.host, cfg.port, version=__version__, exit_on_shutdown=False
     )
+    if cfg.storage.enabled:
+        # BEFORE start(): stage change events from the very first accepted
+        # command — writes landing before the drain thread spins up wait in
+        # the native queue instead of silently bypassing the WAL.
+        server.enable_events(True)
     server.start()
+
+    if cfg.storage.enabled:
+        if storage is None:
+            # port 0: the dir derives from the just-bound port; recovery
+            # still finishes before the readiness line harnesses gate on.
+            storage = DurableStore(
+                engine, cfg.storage, node_data_dir(cfg.storage_path, server.port)
+            )
+            recovery = storage.recover()
+        storage.start()
 
     # Always wire the cluster control plane: the SYNC command must work on a
     # bare node (reference parity — SyncManager is unconditional,
@@ -61,7 +109,7 @@ def main(argv: list[str] | None = None) -> int:
     # enabled in config.
     from merklekv_tpu.cluster.node import ClusterNode
 
-    node = ClusterNode(cfg, engine, server)
+    node = ClusterNode(cfg, engine, server, storage=storage)
     node.start()
 
     # Readiness line LAST: spawning harnesses treat it as "fully up",
@@ -72,6 +120,9 @@ def main(argv: list[str] | None = None) -> int:
         f"(engine={cfg.engine})",
         flush=True,
     )
+    if storage is not None:
+        # After the readiness line — spawning harnesses parse line 1 only.
+        print(f"storage: recovered {recovery.summary()}", flush=True)
 
     stop = {"flag": False}
 
@@ -87,6 +138,11 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         if node is not None:
             node.stop()
+        if storage is not None:
+            # After node.stop() (no more repair/replication writers), before
+            # the server/engine teardown: the final drain + shutdown
+            # snapshot still read through live handles.
+            storage.stop()
         server.close()
         engine.sync()
         engine.close()

@@ -28,10 +28,12 @@ class ClusterNode:
         engine: NativeEngine,
         server: NativeServer,
         transport: Optional[Transport] = None,
+        storage=None,  # Optional[DurableStore], already recovered
     ) -> None:
         self._cfg = cfg
         self._engine = engine
         self._server = server
+        self._storage = storage
         self._transport = transport
         self._owns_transport = transport is None
         self._replicator: Optional[Replicator] = None
@@ -48,6 +50,11 @@ class ClusterNode:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._server.set_cluster_handler(self._on_cluster_command)
+        if self._storage is not None:
+            # WAL recording: the store drains the native change-event queue
+            # itself until a Replicator takes over the drain (then the
+            # store rides its batch listener — one queue, one consumer).
+            self._storage.attach_server(self._server)
         if self._cfg.replication.enabled:
             err = self._enable_replication()
             if err is not None:
@@ -121,15 +128,34 @@ class ClusterNode:
                     self._engine,
                     sharded=self._cfg.device.sharded_mirror,
                 )
-            self._replicator = Replicator(
-                self._engine,
-                self._server,
-                transport,
-                topic_prefix=self._cfg.replication.topic_prefix,
-                node_id=self._cfg.replication.client_id,
-                mirror=self._mirror,
-            )
-            self._replicator.start()
+            storage = self._storage
+            if storage is not None:
+                # Hand the event-queue drain to the replicator; local
+                # writes reach the WAL through its batch listener, remote
+                # applies through the storage hook inside the replicator.
+                storage.pause_drain()
+            try:
+                self._replicator = Replicator(
+                    self._engine,
+                    self._server,
+                    transport,
+                    topic_prefix=self._cfg.replication.topic_prefix,
+                    node_id=self._cfg.replication.client_id,
+                    mirror=self._mirror,
+                    batch_listener=(
+                        storage.record_events if storage is not None else None
+                    ),
+                    storage=storage,
+                )
+                self._replicator.start()
+            except Exception as e:
+                # Take the drain back: a half-failed enable must not leave
+                # WAL recording paused with no batch listener feeding it.
+                self._replicator = None
+                if storage is not None:
+                    self._server.enable_events(True)
+                    storage.resume_drain()
+                return f"replicator start failed: {e}"
             return None
 
     def _disable_replication(self) -> None:
@@ -137,6 +163,11 @@ class ClusterNode:
             if self._replicator is not None:
                 self._replicator.stop()
                 self._replicator = None
+                if self._storage is not None:
+                    # Replicator.stop() turned event staging off; the WAL
+                    # still needs it — take the drain back.
+                    self._server.enable_events(True)
+                    self._storage.resume_drain()
             if self._mirror is not None:
                 # Before any teardown of the native engine: the mirror's
                 # warm thread reads through the engine's raw pointer.
@@ -151,13 +182,29 @@ class ClusterNode:
         if h is not None:
             h.mark_degraded(peer, reason)
 
-    def _on_sync_repair(self, key: bytes, value) -> None:
+    def _on_sync_repair(self, key: bytes, value, ts=None) -> None:
         """Anti-entropy repairs bypass the server event queue; feed the
-        device mirror directly so HASH stays truthful after a SYNC."""
+        device mirror directly so HASH stays truthful after a SYNC, and the
+        WAL so a repaired key survives a crash without needing re-repair."""
         with self._rep_mu:
             mirror = self._mirror
         if mirror is not None:
             mirror.apply_one(key, value)
+        storage = self._storage
+        if storage is not None:
+            # Journal at the EXACT ts the repair installed (threaded through
+            # the listener — an engine read-back here could race a newer
+            # concurrent writer and journal the repair value under the
+            # winner's timestamp). ts None means the repair carried no
+            # ordering metadata (delete_quiet absence copy, legacy full
+            # transfer): skip the journal rather than fabricate a ts —
+            # anti-entropy re-repairs after a crash.
+            if ts is None:
+                return
+            if value is None:
+                storage.record_delete(key, ts)
+            else:
+                storage.record_set(key, value, ts)
 
     def device_root_hex(self) -> Optional[str]:
         """Whole-keyspace Merkle root from the live device tree, or None

@@ -71,10 +71,12 @@ class Replicator:
         drain_interval: float = 0.005,
         batch_listener: Optional[Callable[[list[ChangeEvent]], None]] = None,
         mirror=None,  # Optional[DeviceTreeMirror]
+        storage=None,  # Optional[DurableStore]: journals applied remote writes
         retry: Optional[RetryPolicy] = None,
     ) -> None:
         self._engine = engine
         self._server = server
+        self._storage = storage
         self._transport = transport
         self._topic = f"{topic_prefix}/events"
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:12]}"
@@ -95,18 +97,31 @@ class Replicator:
         # fed inline here — only when the op actually changed state.
         def _set_ts(k: bytes, v: bytes, ts: int) -> bool:
             applied = engine.set_if_newer(k, v, ts)
-            if applied and mirror is not None:
-                mirror.apply_one(k, v)
+            if applied:
+                if mirror is not None:
+                    mirror.apply_one(k, v)
+                if storage is not None:
+                    storage.record_set(k, v, ts)
             return applied
 
         def _del(k: bytes) -> None:
-            if engine.delete(k) and mirror is not None:
-                mirror.apply_one(k, None)
+            if engine.delete(k):
+                if mirror is not None:
+                    mirror.apply_one(k, None)
+                if storage is not None:
+                    # delete() stamped the tombstone "now" inside the
+                    # engine; journal that exact ts for identical replay.
+                    ts = engine.tombstone_ts(k)
+                    if ts is not None:
+                        storage.record_delete(k, ts)
 
         def _del_ts(k: bytes, ts: int) -> bool:
             applied = engine.delete_if_newer(k, ts)
-            if applied and mirror is not None:
-                mirror.apply_one(k, None)
+            if applied:
+                if mirror is not None:
+                    mirror.apply_one(k, None)
+                if storage is not None:
+                    storage.record_delete(k, ts)
             return applied
 
         def _store_ts(k: bytes) -> int:
@@ -148,7 +163,11 @@ class Replicator:
         if self._drain_thread is not None:
             self._drain_thread.join(timeout=5)
             self._drain_thread = None
-        self._server.enable_events(False)
+        if self._storage is None:
+            self._server.enable_events(False)
+        # else: the WAL still needs every write staged — leave events on so
+        # no write acked during this teardown bypasses the journal (the
+        # store's own drain resumes the queue right after).
         self.flush()
         self._transport.unsubscribe(self._on_message)
 

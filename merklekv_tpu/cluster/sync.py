@@ -175,7 +175,13 @@ class SyncManager:
         device: str = "auto",  # "auto" | "cpu" | "tpu"
         mget_batch: int = 512,
         timeout: Optional[float] = None,
-        repair_listener=None,  # Callable[[bytes, Optional[bytes]], None]
+        # Callable[[bytes, Optional[bytes], Optional[int]], None]:
+        # (key, value|None, LWW ts|None). The ts is the EXACT timestamp
+        # the repair installed (peer write ts / tombstone ts), so a WAL
+        # can journal it without a racy engine read-back; None means the
+        # repair carried no ordering metadata (legacy full transfer,
+        # delete_quiet absence copy).
+        repair_listener=None,
         retry: Optional[RetryPolicy] = None,
         on_peer_degraded: Optional[Callable[[str, str], None]] = None,
         hash_page: int = 512,
@@ -814,14 +820,14 @@ class SyncManager:
         else:
             self._engine.set_with_ts(k, v, ts)
         if self._repair_listener is not None:
-            self._repair_listener(k, v)
+            self._repair_listener(k, v, ts)
 
     def _repair_set_lww(self, k: bytes, v: bytes, ts: int) -> bool:
         """Conditional install for multi-peer repair: a local write or
         deletion racing ahead of the fetched winner must not be clobbered."""
         applied = self._engine.set_if_newer(k, v, ts)
         if applied and self._repair_listener is not None:
-            self._repair_listener(k, v)
+            self._repair_listener(k, v, ts)
         return applied
 
     def _repair_delete(self, k: bytes, tomb_ts: Optional[int] = None) -> None:
@@ -839,7 +845,7 @@ class SyncManager:
         else:
             self._engine.delete_with_ts(k, tomb_ts)
         if self._repair_listener is not None:
-            self._repair_listener(k, None)
+            self._repair_listener(k, None, tomb_ts)
 
     def _repair_delete_lww(self, k: bytes, ts: int, was_present: bool) -> bool:
         """Conditional deletion for multi-peer repair (peer tombstone won).
@@ -851,7 +857,7 @@ class SyncManager:
         keys). ``was_present`` only scopes the report count."""
         applied = self._engine.delete_if_newer(k, ts)
         if applied and self._repair_listener is not None:
-            self._repair_listener(k, None)
+            self._repair_listener(k, None, ts)
         return applied and was_present
 
     # -- multi-peer cycle -----------------------------------------------------

@@ -153,6 +153,45 @@ class AntiEntropyConfig:
 
 
 @dataclass
+class StorageConfig:
+    """[storage]: the durable subsystem (merklekv_tpu/storage/).
+
+    Off by default — a bare node stays the in-memory engine the seed
+    shipped. When enabled, the node journals every observed write to a
+    CRC-framed WAL under ``<storage_path>/node-<port>/``, compacts into
+    Merkle-root-stamped snapshots, and recovers (verified) on restart.
+    See docs/PERSISTENCE.md.
+    """
+
+    enabled: bool = False
+    # "always": fsync inside every append (max durability, ~1 fsync per
+    # drained batch); "interval": fsync every fsync_interval_seconds;
+    # "never": OS writeback only.
+    fsync: str = "interval"
+    fsync_interval_seconds: float = 0.05
+    # Rotate WAL segments at this size.
+    segment_bytes: int = 4 << 20
+    # Background compaction (snapshot + truncate old segments) triggers
+    # when this many WAL bytes accumulate since the last snapshot; 0
+    # disables the trigger (explicit/shutdown snapshots only).
+    compact_trigger_bytes: int = 32 << 20
+    # Keep this many snapshots; older WAL segments only survive while a
+    # retained snapshot still needs them for replay.
+    snapshots_retained: int = 2
+    # "repair": a snapshot failing root verification is rejected and
+    # recovery falls back (older snapshot, else full WAL replay);
+    # "strict": refuse to start instead.
+    verify: str = "repair"
+    # Root stamping/verification path: "auto" uses the device bulk rebuild
+    # for keyspaces >= device_min_keys, "cpu" pins host hashing (no jax
+    # import), "tpu" always tries the device.
+    merkle_engine: str = "auto"
+    device_min_keys: int = 4096
+    # Write a final snapshot on clean shutdown (fast, verified restarts).
+    snapshot_on_shutdown: bool = True
+
+
+@dataclass
 class DeviceConfig:
     # Shard the serving Merkle tree's leaf level over ALL local JAX devices
     # (GSPMD over a "key" mesh). Single-device trees are the default; on a
@@ -170,6 +209,7 @@ class Config:
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
 
     @classmethod
     def load(cls, path: str) -> "Config":
@@ -217,6 +257,40 @@ class Config:
         dev = raw.get("device", {})
         if "sharded_mirror" in dev:
             cfg.device.sharded_mirror = bool(dev["sharded_mirror"])
+        st = raw.get("storage", {})
+        for k in ("enabled", "snapshot_on_shutdown"):
+            if k in st:
+                setattr(cfg.storage, k, bool(st[k]))
+        for k in ("fsync", "verify", "merkle_engine"):
+            if k in st:
+                setattr(cfg.storage, k, str(st[k]))
+        for k in (
+            "segment_bytes",
+            "compact_trigger_bytes",
+            "snapshots_retained",
+            "device_min_keys",
+        ):
+            if k in st:
+                setattr(cfg.storage, k, int(st[k]))
+        if "fsync_interval_seconds" in st:
+            cfg.storage.fsync_interval_seconds = float(
+                st["fsync_interval_seconds"]
+            )
+        if cfg.storage.fsync not in ("always", "interval", "never"):
+            raise ValueError(
+                f"[storage] fsync must be always|interval|never, "
+                f"got {cfg.storage.fsync!r}"
+            )
+        if cfg.storage.verify not in ("repair", "strict"):
+            raise ValueError(
+                f"[storage] verify must be repair|strict, "
+                f"got {cfg.storage.verify!r}"
+            )
+        if cfg.storage.merkle_engine not in ("auto", "cpu", "tpu"):
+            raise ValueError(
+                f"[storage] merkle_engine must be auto|cpu|tpu, "
+                f"got {cfg.storage.merkle_engine!r}"
+            )
         cfg.replication.resolve_env()
         return cfg
 

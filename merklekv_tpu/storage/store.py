@@ -1,0 +1,545 @@
+"""DurableStore: WAL + snapshots + verified crash recovery for one node.
+
+Sits beside the native engine (which stays the in-memory serving hot path)
+and records every change the Python control plane can observe:
+
+- local client writes, drained from the native server's change-event queue
+  (either by this store's own drain thread, or — while replication is
+  enabled — piggybacked on the Replicator's drain via its batch listener,
+  so the single native queue has exactly one consumer at a time);
+- remote replication applies (the Replicator reports applied events here);
+- anti-entropy repairs (ClusterNode's repair hook reports them here).
+
+Durability contract (docs/PERSISTENCE.md): WAL append is asynchronous with
+respect to command acknowledgement — the native server acks before the
+event is drained — so a SIGKILL loses at most the drain window (~ms) plus
+whatever the fsync policy left unflushed. Recovery restores a write-order
+contiguous prefix, verified against the snapshot's stamped Merkle root;
+anti-entropy repairs the lost tail from peers.
+
+Recovery replays through the engine's LWW verbs (``set_if_newer`` /
+``delete_if_newer``): replay is idempotent, records shared between a
+snapshot and the WAL tail apply as no-ops, and tombstone ordering
+survives a restart.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from merklekv_tpu.storage import snapshot as snapmod
+from merklekv_tpu.storage import wal as walmod
+from merklekv_tpu.storage.snapshot import (
+    RootMismatchError,
+    SnapshotCorruptError,
+)
+from merklekv_tpu.storage.wal import WalRecord, WalWriter
+from merklekv_tpu.utils.tracing import get_metrics, span
+
+__all__ = [
+    "DurableStore",
+    "RecoveryError",
+    "RecoveryReport",
+    "StorageLockedError",
+    "node_data_dir",
+]
+
+# Native change-event op codes (native_bindings) observed on the drain path.
+from merklekv_tpu.native_bindings import (  # noqa: E402  (grouped for clarity)
+    OP_DEL,
+    OP_TRUNCATE,
+    ChangeEventRaw,
+    NativeEngine,
+)
+
+
+class StorageLockedError(RuntimeError):
+    """Another live process holds this data directory."""
+
+
+class RecoveryError(RuntimeError):
+    """Recovery refused to proceed (strict verify mode) — the on-disk state
+    failed integrity checks and repair was not allowed."""
+
+
+def node_data_dir(storage_path: str, port: int) -> str:
+    """Per-node data directory: ``<storage_path>/node-<port>``.
+
+    Two nodes sharing a cwd (the integration-test shape) get disjoint
+    directories as long as they bind different ports; the flock in
+    :class:`DurableStore` rejects the remaining collision cases.
+    """
+    return os.path.join(storage_path, f"node-{port}")
+
+
+@dataclass
+class RecoveryReport:
+    directory: str
+    snapshot_path: Optional[str] = None
+    snapshot_items: int = 0
+    snapshot_tombstones: int = 0
+    snapshot_root: Optional[str] = None
+    snapshots_rejected: list[str] = field(default_factory=list)
+    wal_segments: int = 0
+    replayed: int = 0  # frames replayed through the LWW verbs
+    applied: int = 0  # frames that actually changed engine state
+    torn_tail: bool = False
+    corruption: Optional[str] = None  # mid-log corruption note (repair mode)
+    final_root: Optional[str] = None  # engine root after recovery
+
+    def summary(self) -> str:
+        src = (
+            os.path.basename(self.snapshot_path)
+            if self.snapshot_path
+            else "no snapshot"
+        )
+        extra = ""
+        if self.torn_tail:
+            extra += " torn-tail-cut"
+        if self.snapshots_rejected:
+            extra += f" rejected={len(self.snapshots_rejected)}"
+        if self.corruption:
+            extra += " corruption-stopped-replay"
+        return (
+            f"{src} ({self.snapshot_items} items) + {self.replayed} WAL "
+            f"records from {self.wal_segments} segment(s)"
+            f"{extra}; root={(self.final_root or '')[:16]}"
+        )
+
+
+class DurableStore:
+    """One node's durable storage subsystem. Lifecycle::
+
+        store = DurableStore(engine, cfg, directory)
+        report = store.recover()       # before serving writes
+        store.attach_server(server)    # own drain thread over the event queue
+        store.start()                  # fsync ticker + compaction trigger
+        ...
+        store.stop()                   # final drain + fsync (+ snapshot)
+
+    ``cfg`` is a :class:`merklekv_tpu.config.StorageConfig`.
+    """
+
+    def __init__(self, engine: NativeEngine, cfg, directory: str) -> None:
+        self._engine = engine
+        self._cfg = cfg
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock_fd = self._acquire_lock(directory)
+        self._writer: Optional[WalWriter] = None
+        self._server = None
+        self._paused = False
+        self._drain_iter_mu = threading.Lock()  # one drain iteration at a time
+        self._stop_evt = threading.Event()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._ticker_thread: Optional[threading.Thread] = None
+        self._bytes_since_snapshot = 0
+        self._snap_mu = threading.Lock()
+        # Set when a TRUNCATE was journaled: the WAL interleaves several
+        # append paths (event drain, repair hooks, replication applies), so
+        # a frame journaled just before the TRUNCATE frame may have been
+        # applied to the engine just AFTER the wipe — replay would then
+        # wipe a key the live engine kept. A prompt snapshot (engine state
+        # is authoritative ordering) collapses that window to the next
+        # ticker tick.
+        self._snapshot_requested = False
+        self.last_recovery: Optional[RecoveryReport] = None
+
+    # -- locking --------------------------------------------------------------
+    @staticmethod
+    def _acquire_lock(directory: str) -> int:
+        import fcntl
+
+        path = os.path.join(directory, "LOCK")
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            raise StorageLockedError(
+                f"storage directory {directory!r} is locked by a live "
+                "process — two nodes must not share one data dir (give "
+                "each its own storage_path, or distinct ports so the "
+                "per-port subdirectory separates them)"
+            ) from None
+        os.ftruncate(fd, 0)
+        os.write(fd, f"{os.getpid()}\n".encode())
+        return fd
+
+    # -- recovery -------------------------------------------------------------
+    def recover(self) -> RecoveryReport:
+        """Load the newest verifiable snapshot, replay the WAL tail, open
+        the WAL for appending. Must run before the node serves writes."""
+        cfg = self._cfg
+        report = RecoveryReport(directory=self._dir)
+        strict = cfg.verify == "strict"
+        with span("storage.recovery"):
+            snap = self._load_best_snapshot(report, strict)
+            start_seq = snap.wal_seq if snap is not None else 0
+            segments = [
+                (seq, path)
+                for seq, path in walmod.list_segments(self._dir)
+                if seq >= start_seq
+            ]
+            report.wal_segments = len(segments)
+            last_good_offset: Optional[int] = None
+            for i, (seq, path) in enumerate(segments):
+                scan = walmod.scan_segment(path)
+                self._replay_records(scan.records, report)
+                if scan.clean:
+                    continue
+                is_last = i == len(segments) - 1
+                if scan.torn and is_last:
+                    # The normal crash signature: a partial final append.
+                    # Cut it on reopen so future appends extend a clean log.
+                    report.torn_tail = True
+                    last_good_offset = scan.good_offset
+                    get_metrics().inc("storage.recovery_torn_tail")
+                    continue
+                # Interior corruption (or a non-final torn segment — same
+                # thing for replay): everything past it is unverifiable.
+                get_metrics().inc("storage.recovery_wal_corruption")
+                msg = f"{os.path.basename(path)}: {scan.error}"
+                if strict:
+                    raise RecoveryError(
+                        f"WAL corruption, refusing to recover ({msg}); run "
+                        f"`python -m merklekv_tpu walcheck {self._dir}`"
+                    )
+                report.corruption = msg
+                # Re-anchor durability promptly: without a fresh snapshot,
+                # every FUTURE recovery would replay up to this same bad
+                # segment and skip everything after it — including all
+                # post-recovery writes — until the byte-trigger compaction
+                # finally fires.
+                self._snapshot_requested = True
+                break
+            # Open the writer on the newest segment (clean tail cut if torn).
+            if segments and report.corruption is None:
+                open_seq = segments[-1][0]
+            elif segments:
+                # Replay stopped early; never append after bad bytes —
+                # start a fresh segment beyond everything on disk.
+                open_seq = walmod.list_segments(self._dir)[-1][0] + 1
+                last_good_offset = None
+            else:
+                open_seq = start_seq
+            self._writer = WalWriter(
+                self._dir,
+                open_seq,
+                fsync_policy=cfg.fsync,
+                segment_bytes=cfg.segment_bytes,
+                start_offset=last_good_offset,
+            )
+            root = self._engine.merkle_root()
+            report.final_root = (
+                root.hex() if root is not None else snapmod.EMPTY_ROOT_HEX
+            )
+        get_metrics().inc("storage.recoveries")
+        self.last_recovery = report
+        return report
+
+    def _load_best_snapshot(self, report, strict):
+        cfg = self._cfg
+        for seq, path in reversed(snapmod.list_snapshots(self._dir)):
+            try:
+                snap = snapmod.read_snapshot(path)
+                snapmod.verify_snapshot(
+                    snap,
+                    engine=cfg.merkle_engine,
+                    device_min_keys=cfg.device_min_keys,
+                )
+            except (SnapshotCorruptError, RootMismatchError) as e:
+                get_metrics().inc("storage.recovery_root_mismatch")
+                if strict:
+                    raise RecoveryError(
+                        f"snapshot failed verification, refusing to recover "
+                        f"({e}); run `python -m merklekv_tpu walcheck "
+                        f"{self._dir}` or set [storage] verify = \"repair\""
+                    ) from e
+                report.snapshots_rejected.append(
+                    f"{os.path.basename(path)}: {e}"
+                )
+                continue
+            for k, v, ts in snap.items:
+                self._engine.set_if_newer(k, v, ts)
+            for k, ts in snap.tombstones:
+                self._engine.delete_if_newer(k, ts)
+            report.snapshot_path = path
+            report.snapshot_items = len(snap.items)
+            report.snapshot_tombstones = len(snap.tombstones)
+            report.snapshot_root = snap.root_hex
+            return snap
+        return None
+
+    def _replay_records(self, records, report) -> None:
+        eng = self._engine
+        for rec in records:
+            if rec.op == walmod.OP_SET:
+                applied = eng.set_if_newer(rec.key, rec.value or b"", rec.ts)
+            elif rec.op == walmod.OP_DEL:
+                applied = eng.delete_if_newer(rec.key, rec.ts)
+            else:  # OP_TRUNCATE
+                eng.truncate()
+                applied = True
+            report.replayed += 1
+            if applied:
+                report.applied += 1
+        get_metrics().inc("storage.recovery_replayed", len(records))
+
+    # -- runtime --------------------------------------------------------------
+    def attach_server(self, server) -> None:
+        """Start draining the native server's change-event queue into the
+        WAL. While a Replicator runs, call :meth:`pause_drain` and route its
+        batch listener here instead — the queue has ONE consumer at a time."""
+        self._server = server
+        server.enable_events(True)
+        if self._drain_thread is None:
+            self._drain_thread = threading.Thread(
+                target=self._drain_loop, daemon=True, name="mkv-storage-drain"
+            )
+            self._drain_thread.start()
+
+    def start(self) -> None:
+        """Start the fsync-interval / compaction ticker."""
+        if self._ticker_thread is None:
+            self._ticker_thread = threading.Thread(
+                target=self._ticker_loop, daemon=True, name="mkv-storage-tick"
+            )
+            self._ticker_thread.start()
+
+    def pause_drain(self) -> None:
+        """Stop consuming the event queue AND wait out any in-flight drain
+        iteration, so a successor consumer (the Replicator) never races a
+        batch this thread already popped — such a batch would reach the WAL
+        but skip the publish/mirror path."""
+        self._paused = True
+        with self._drain_iter_mu:
+            pass
+
+    def resume_drain(self) -> None:
+        self._paused = False
+
+    def _drain_loop(self) -> None:
+        interval = 0.005
+        while not self._stop_evt.is_set():
+            with self._drain_iter_mu:
+                if self._paused or self._server is None:
+                    raws = None
+                else:
+                    try:
+                        raws = self._server.drain_events()
+                    except Exception:
+                        raws = []
+                    if raws:
+                        self.record_raw(raws)
+            if raws is None:
+                time.sleep(0.02)
+            elif not raws:
+                time.sleep(interval)
+
+    def _ticker_loop(self) -> None:
+        cfg = self._cfg
+        tick = min(max(cfg.fsync_interval_seconds, 0.01), 0.5)
+        last_fsync = time.monotonic()
+        while not self._stop_evt.wait(tick):
+            now = time.monotonic()
+            if (
+                cfg.fsync == "interval"
+                and now - last_fsync >= cfg.fsync_interval_seconds
+            ):
+                self.fsync()
+                last_fsync = now
+            if self._snapshot_requested or (
+                cfg.compact_trigger_bytes > 0
+                and self._bytes_since_snapshot >= cfg.compact_trigger_bytes
+            ):
+                try:
+                    self.compact()
+                    # Only a SUCCESSFUL snapshot satisfies the request — a
+                    # transient failure (ENOSPC, device hiccup) must keep
+                    # the re-anchor pending or corruption recovery's
+                    # replay barrier never moves.
+                    self._snapshot_requested = False
+                except Exception:
+                    get_metrics().inc("storage.compaction_errors")
+
+    # -- record ingestion ------------------------------------------------------
+    def record_raw(self, raws: list[ChangeEventRaw]) -> None:
+        """Record a drained batch of native change events."""
+        recs = []
+        for r in raws:
+            if r.op == OP_DEL:
+                recs.append(WalRecord(walmod.OP_DEL, r.key, None, r.ts_ns))
+            elif r.op == OP_TRUNCATE:
+                recs.append(
+                    WalRecord(walmod.OP_TRUNCATE, b"", None, r.ts_ns)
+                )
+                self._snapshot_requested = True
+            elif r.has_value:
+                # SET / INCR / DECR / APPEND / PREPEND all carry the post-op
+                # value, so each replays as an idempotent timestamped SET.
+                recs.append(WalRecord(walmod.OP_SET, r.key, r.value, r.ts_ns))
+        self._append_many(recs)
+
+    def record_events(self, events) -> None:
+        """Replicator batch-listener entry: decoded local ChangeEvents."""
+        from merklekv_tpu.cluster.change_event import OpKind
+
+        recs = []
+        for ev in events:
+            key = ev.key.encode("utf-8", "surrogateescape")
+            if ev.op is OpKind.DEL:
+                recs.append(WalRecord(walmod.OP_DEL, key, None, ev.ts))
+            elif ev.op is OpKind.TRUNCATE:
+                recs.append(WalRecord(walmod.OP_TRUNCATE, b"", None, ev.ts))
+                self._snapshot_requested = True
+            elif ev.val is not None:
+                recs.append(WalRecord(walmod.OP_SET, key, ev.val, ev.ts))
+        self._append_many(recs)
+
+    def record_set(self, key: bytes, value: bytes, ts: int) -> None:
+        """Record one applied write (replication apply, sync repair)."""
+        self._append_many([WalRecord(walmod.OP_SET, key, value, ts)])
+
+    def record_delete(self, key: bytes, ts: int) -> None:
+        self._append_many([WalRecord(walmod.OP_DEL, key, None, ts)])
+
+    def _append_many(self, recs: list[WalRecord]) -> None:
+        if not recs or self._writer is None:
+            return
+        n = self._writer.append_many(recs)
+        size = sum(len(r.key) + len(r.value or b"") + 25 for r in recs)
+        self._bytes_since_snapshot += size
+        m = get_metrics()
+        m.inc("storage.wal_appends", n)
+        if self._cfg.fsync == "always":
+            m.inc("storage.wal_fsyncs")
+
+    def fsync(self) -> None:
+        w = self._writer
+        if w is not None and w.fsync():
+            get_metrics().inc("storage.wal_fsyncs")
+
+    # -- snapshots / compaction ------------------------------------------------
+    def compact(self) -> str:
+        """Snapshot current engine state, then drop WAL segments and old
+        snapshots the retention policy no longer needs. Returns the new
+        snapshot's path."""
+        path = self.snapshot_now()
+        get_metrics().inc("storage.compactions")
+        return path
+
+    def snapshot_now(self) -> str:
+        """Write a Merkle-stamped snapshot of the engine's current state.
+
+        Rotation first: the snapshot's ``wal_seq`` is the fresh segment's
+        seq, so state captured *after* rotation strictly covers everything
+        in older segments, and records racing into the fresh segment replay
+        as no-ops (LWW idempotence)."""
+        with self._snap_mu, span("storage.snapshot") as out:
+            assert self._writer is not None, "recover() before snapshot_now()"
+            cutoff_seq = self._writer.rotate()
+            t0 = time.perf_counter()
+            # Timestamps BEFORE values: the three reads are separate native
+            # calls, so a racing write lands in at most the later ones. A
+            # newer value paired with an older/absent ts is safe — the
+            # write's own WAL frame (in the fresh post-rotation segment,
+            # always replayed) carries the true ts and wins set_if_newer on
+            # recovery. The reverse pairing (old value, new ts) would make
+            # recovery's equal-ts digest tiebreak stick the stale value.
+            ts_map = dict(self._engine.key_timestamps())
+            items = self._engine.snapshot()
+            tombs = self._engine.tombstones()
+            root = snapmod.compute_root_hex(
+                items,
+                engine=self._cfg.merkle_engine,
+                device_min_keys=self._cfg.device_min_keys,
+            )
+            snaps = snapmod.list_snapshots(self._dir)
+            seq = (snaps[-1][0] + 1) if snaps else 1
+            path = snapmod.write_snapshot(
+                self._dir,
+                seq,
+                [(k, v, ts_map.get(k, 0)) for k, v in items],
+                tombs,
+                cutoff_seq,
+                root,
+            )
+            self._bytes_since_snapshot = 0
+            seconds = time.perf_counter() - t0
+            out["items"] = len(items)
+            out["root"] = root[:16]
+            m = get_metrics()
+            m.inc("storage.snapshots")
+            m.inc("storage.snapshot_seconds_ms", int(seconds * 1e3))
+            self._apply_retention()
+        return path
+
+    def _apply_retention(self) -> None:
+        """Keep the newest ``snapshots_retained`` snapshots; drop WAL
+        segments older than the oldest retained snapshot's cutoff (the
+        oldest snapshot must still be able to replay forward — that is the
+        repair path's fallback when the newest snapshot fails verify)."""
+        keep = max(1, self._cfg.snapshots_retained)
+        snaps = snapmod.list_snapshots(self._dir)
+        for _, path in snaps[:-keep]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        retained = snaps[-keep:]
+        if not retained:
+            return
+        min_seq = None
+        for _, path in retained:
+            try:
+                min_seq_c = snapmod.read_snapshot_wal_seq(path)
+            except (SnapshotCorruptError, OSError):
+                return  # unreadable retained snapshot: keep every segment
+            min_seq = min_seq_c if min_seq is None else min(min_seq, min_seq_c)
+        active = self._writer.seq if self._writer is not None else None
+        for seq, path in walmod.list_segments(self._dir):
+            if seq < min_seq and seq != active:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # -- shutdown --------------------------------------------------------------
+    def stop(self) -> None:
+        """Final drain + fsync (+ shutdown snapshot), release the lock."""
+        self._stop_evt.set()
+        for t in (self._drain_thread, self._ticker_thread):
+            if t is not None:
+                t.join(timeout=5)
+        self._drain_thread = self._ticker_thread = None
+        if self._server is not None and not self._paused:
+            try:
+                self.record_raw(self._server.drain_events())
+            except Exception:
+                pass
+        if self._writer is not None:
+            if self._cfg.snapshot_on_shutdown:
+                try:
+                    self.snapshot_now()
+                except Exception:
+                    get_metrics().inc("storage.compaction_errors")
+            self.fsync()
+            self._writer.close()
+            self._writer = None
+        if self._lock_fd >= 0:
+            os.close(self._lock_fd)
+            self._lock_fd = -1
+
+    # -- introspection ---------------------------------------------------------
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    @property
+    def wal_seq(self) -> Optional[int]:
+        return self._writer.seq if self._writer is not None else None

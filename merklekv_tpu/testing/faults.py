@@ -38,7 +38,14 @@ import time
 from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
-__all__ = ["FaultSpec", "FaultInjector", "FaultyTransport", "PeerProcessKiller"]
+__all__ = [
+    "FaultSpec",
+    "FaultInjector",
+    "FaultyTransport",
+    "PeerProcessKiller",
+    "truncate_file",
+    "corrupt_file",
+]
 
 
 @dataclass(frozen=True)
@@ -378,6 +385,33 @@ class FaultyTransport:
 
     def __getattr__(self, name):  # reconnects/outbox counters etc.
         return getattr(self._inner, name)
+
+
+def truncate_file(path: str, size: int) -> int:
+    """Hard-truncate ``path`` to ``size`` bytes — the on-disk signature a
+    crash leaves when it tears the tail of an append-only log. Returns the
+    number of bytes removed. The durable-storage torn-tail suite sweeps
+    this over every byte offset of the final WAL frame."""
+    import os
+
+    old = os.path.getsize(path)
+    if size > old:
+        raise ValueError(f"cannot truncate {path} up: {size} > {old}")
+    with open(path, "r+b") as f:
+        f.truncate(size)
+    return old - size
+
+
+def corrupt_file(path: str, offset: int, xor: int = 0xFF) -> None:
+    """Flip bits of one byte in place (bit rot / middlebox damage on a
+    stored artifact, as opposed to :func:`truncate_file`'s torn tail)."""
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        if len(b) != 1:
+            raise ValueError(f"offset {offset} beyond EOF of {path}")
+        f.seek(offset)
+        f.write(bytes([b[0] ^ xor]))
 
 
 class PeerProcessKiller:

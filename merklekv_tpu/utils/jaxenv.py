@@ -14,7 +14,50 @@ import os
 import threading
 import warnings
 
-__all__ = ["ensure_platform", "note_device_failure", "device_failed"]
+__all__ = [
+    "ensure_platform",
+    "note_device_failure",
+    "device_failed",
+    "probe_default_backend",
+]
+
+
+def probe_default_backend(timeout: float = 90.0) -> "str | None":
+    """Resolve ``jax.default_backend()`` in a THROWAWAY subprocess, bounded.
+
+    Backend init against a tunneled/absent/already-claimed TPU can raise —
+    or hang past any useful deadline — and once the parent process has
+    tried and failed, ``jax_platforms`` may be frozen mid-init with no
+    recourse (bench.py's old in-process fallback hit exactly that:
+    BENCH_r05 died rc=1 with no JSON). Probing in a child keeps the
+    parent's jax import pristine: on None (probe crashed or timed out),
+    callers pin the parent to CPU *before* its first jax import.
+    """
+    import subprocess
+    import sys
+
+    # Environment already pins a non-TPU platform (the test tier, spawned
+    # server processes): the answer is forced, skip the throwaway child.
+    pinned = os.environ.get("JAX_PLATFORMS") or os.environ.get(
+        "MERKLEKV_JAX_PLATFORM"
+    )
+    if pinned and "tpu" not in pinned:
+        return pinned.split(",")[0]
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if out.returncode != 0:
+        return None
+    name = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    return name or None
 
 
 def ensure_platform() -> None:

@@ -76,3 +76,57 @@ def test_env_first_secrets(monkeypatch):
     cfg = Config.from_dict({"replication": {"client_id": "file-id"}})
     assert cfg.replication.client_id == "env-id"
     assert cfg.replication.password == "env-pw"
+
+
+def test_storage_defaults_off():
+    cfg = Config()
+    assert not cfg.storage.enabled
+    assert cfg.storage.fsync == "interval"
+    assert cfg.storage.verify == "repair"
+    assert cfg.storage.snapshots_retained == 2
+
+
+def test_storage_section_parse(tmp_path):
+    p = tmp_path / "s.toml"
+    p.write_text(
+        """
+storage_path = "./data"
+
+[storage]
+enabled = true
+fsync = "always"
+fsync_interval_seconds = 0.2
+segment_bytes = 65536
+compact_trigger_bytes = 1048576
+snapshots_retained = 3
+verify = "strict"
+merkle_engine = "cpu"
+snapshot_on_shutdown = false
+"""
+    )
+    cfg = Config.load(str(p))
+    assert cfg.storage.enabled
+    assert cfg.storage.fsync == "always"
+    assert cfg.storage.fsync_interval_seconds == 0.2
+    assert cfg.storage.segment_bytes == 65536
+    assert cfg.storage.compact_trigger_bytes == 1048576
+    assert cfg.storage.snapshots_retained == 3
+    assert cfg.storage.verify == "strict"
+    assert cfg.storage.merkle_engine == "cpu"
+    assert not cfg.storage.snapshot_on_shutdown
+
+
+def test_storage_rejects_bad_enums():
+    import pytest
+
+    with pytest.raises(ValueError, match="fsync"):
+        Config.from_dict({"storage": {"fsync": "sometimes"}})
+    with pytest.raises(ValueError, match="verify"):
+        Config.from_dict({"storage": {"verify": "hope"}})
+
+
+def test_storage_rejects_bad_merkle_engine():
+    import pytest
+
+    with pytest.raises(ValueError, match="merkle_engine"):
+        Config.from_dict({"storage": {"merkle_engine": "device"}})

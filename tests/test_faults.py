@@ -167,7 +167,7 @@ def test_peer_death_mid_sync_checkpoints_and_resumes(make_pair):
     p = make_pair(seed=23, divergent=120, mget_batch=8)
     repairs: list[bytes] = []
 
-    def killer_listener(key, value):
+    def killer_listener(key, value, ts=None):
         repairs.append(key)
         if len(repairs) == 20:
             p.inj.kill_peer()
@@ -231,7 +231,7 @@ def test_multi_peer_cycle_survives_mid_sync_peer_death(make_pair):
     degraded: list[str] = []
     killed = []
 
-    def listener(key, value):
+    def listener(key, value, ts=None):
         # First b-key repair kills peer B mid-stream.
         if key.startswith(b"b") and not killed:
             killed.append(key)

@@ -1,0 +1,104 @@
+"""Snapshot serialization + Merkle root stamping/verification."""
+
+import os
+
+import pytest
+
+from merklekv_tpu.merkle.encoding import EMPTY_ROOT_HEX, leaf_hash
+from merklekv_tpu.native_bindings import NativeEngine
+from merklekv_tpu.storage import snapshot as snapmod
+from merklekv_tpu.testing.faults import corrupt_file, truncate_file
+
+
+def _items(n):
+    return [
+        (b"key%04d" % i, b"value-%d" % i, 10_000 + i) for i in range(n)
+    ]
+
+
+def _write(tmp_path, items=None, tombs=None, wal_seq=7, root=None, seq=1):
+    items = _items(30) if items is None else items
+    tombs = [(b"gone", 999), (b"also-gone", 1234)] if tombs is None else tombs
+    if root is None:
+        root = snapmod.compute_root_hex(
+            [(k, v) for k, v, _ in items], engine="cpu"
+        )
+    return snapmod.write_snapshot(
+        str(tmp_path), seq, items, tombs, wal_seq, root
+    )
+
+
+def test_roundtrip(tmp_path):
+    items = _items(30)
+    path = _write(tmp_path, items=items)
+    snap = snapmod.read_snapshot(path)
+    assert snap.items == items
+    assert snap.tombstones == [(b"gone", 999), (b"also-gone", 1234)]
+    assert snap.wal_seq == 7
+    assert snapmod.verify_snapshot(snap, engine="cpu") == snap.root_hex
+
+
+def test_root_matches_native_engine(tmp_path):
+    """The stamp equals what the serving engine answers for HASH — one
+    Merkle spec across native, CPU, device, and the snapshot stamp."""
+    eng = NativeEngine("mem")
+    try:
+        for k, v, ts in _items(50):
+            eng.set_with_ts(k, v, ts)
+        native_root = eng.merkle_root().hex()
+        stamped = snapmod.compute_root_hex(
+            [(k, v) for k, v, _ in _items(50)], engine="cpu"
+        )
+        assert stamped == native_root
+    finally:
+        eng.close()
+
+
+def test_root_device_path_parity(tmp_path):
+    """CPU fallback and the device bulk path stamp the same root (the
+    virtual-CPU jax backend stands in for the chip in CI)."""
+    pairs = [(k, v) for k, v, _ in _items(64)]
+    assert snapmod.compute_root_hex(pairs, engine="cpu") == (
+        snapmod.compute_root_hex(pairs, engine="tpu")
+    )
+
+
+def test_empty_root_stamp(tmp_path):
+    path = _write(tmp_path, items=[], tombs=[], root=EMPTY_ROOT_HEX)
+    snap = snapmod.read_snapshot(path)
+    assert snap.root_hex == EMPTY_ROOT_HEX
+    assert snapmod.verify_snapshot(snap, engine="cpu") == EMPTY_ROOT_HEX
+
+
+def test_crc_catches_bit_rot(tmp_path):
+    path = _write(tmp_path)
+    corrupt_file(path, os.path.getsize(path) // 2)
+    with pytest.raises(snapmod.SnapshotCorruptError):
+        snapmod.read_snapshot(path)
+
+
+def test_short_file_is_corrupt(tmp_path):
+    path = _write(tmp_path)
+    truncate_file(path, os.path.getsize(path) - 9)
+    with pytest.raises(snapmod.SnapshotCorruptError):
+        snapmod.read_snapshot(path)
+
+
+def test_wrong_stamp_is_root_mismatch(tmp_path):
+    """A decodable snapshot whose content hashes differently from its
+    header stamp raises the DISTINCT error recovery keys off of."""
+    bogus = leaf_hash(b"not", b"the-state").hex()
+    path = _write(tmp_path, root=bogus)
+    snap = snapmod.read_snapshot(path)  # CRC is fine — content is intact
+    with pytest.raises(snapmod.RootMismatchError) as ei:
+        snapmod.verify_snapshot(snap, engine="cpu")
+    assert ei.value.stamped == bogus
+    assert ei.value.actual == snapmod.compute_root_hex(
+        [(k, v) for k, v, _ in _items(30)], engine="cpu"
+    )
+
+
+def test_listing_orders_by_seq(tmp_path):
+    for seq in (3, 1, 2):
+        _write(tmp_path, seq=seq)
+    assert [s for s, _ in snapmod.list_snapshots(str(tmp_path))] == [1, 2, 3]

@@ -1,0 +1,485 @@
+"""DurableStore: recovery, verification modes, compaction, event mapping.
+
+In-process crash simulation: write with ``snapshot_on_shutdown=False`` (the
+WAL stays the only recovery source), then tamper with the files the way a
+crash/bit-rot would before recovering into a fresh engine. Real SIGKILL
+crashes are covered process-level in tests/test_storage_chaos.py.
+"""
+
+import os
+import time
+
+import pytest
+
+from merklekv_tpu.config import StorageConfig
+from merklekv_tpu.native_bindings import (
+    OP_DEL,
+    OP_INCR,
+    OP_SET,
+    OP_TRUNCATE,
+    ChangeEventRaw,
+    NativeEngine,
+)
+from merklekv_tpu.storage import (
+    DurableStore,
+    RecoveryError,
+    StorageLockedError,
+)
+from merklekv_tpu.storage import snapshot as snapmod
+from merklekv_tpu.storage import wal as walmod
+from merklekv_tpu.storage.walcheck import check_dir, replay_root_hex
+from merklekv_tpu.testing.faults import corrupt_file, truncate_file
+from merklekv_tpu.utils.tracing import get_metrics
+
+
+def _cfg(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("fsync", "always")
+    kw.setdefault("merkle_engine", "cpu")
+    kw.setdefault("snapshot_on_shutdown", False)
+    return StorageConfig(**kw)
+
+
+@pytest.fixture
+def engine():
+    eng = NativeEngine("mem")
+    yield eng
+    eng.close()
+
+
+def _fill(eng, store, n, base_ts=None):
+    ts0 = base_ts if base_ts is not None else time.time_ns()
+    for i in range(n):
+        k, v = b"k%04d" % i, b"v-%d" % i
+        eng.set_with_ts(k, v, ts0 + i)
+        store.record_set(k, v, ts0 + i)
+    return ts0
+
+
+def test_recover_roundtrip_with_tombstones(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    ts0 = _fill(engine, st, 40)
+    engine.delete_with_ts(b"k0007", ts0 + 100)
+    st.record_delete(b"k0007", ts0 + 100)
+    expect_root = engine.merkle_root().hex()
+    st.stop()
+
+    eng2 = NativeEngine("mem")
+    try:
+        st2 = DurableStore(eng2, _cfg(), d)
+        rep = st2.recover()
+        assert rep.replayed == 41
+        assert eng2.merkle_root().hex() == expect_root
+        assert eng2.get(b"k0007") is None
+        # The tombstone survived with its LWW ordering: an older write
+        # cannot resurrect the key after recovery.
+        assert not eng2.set_if_newer(b"k0007", b"stale", ts0 + 50)
+        assert eng2.set_if_newer(b"k0007", b"fresh", ts0 + 200)
+        st2.stop()
+    finally:
+        eng2.close()
+
+
+def test_snapshot_plus_wal_tail_is_idempotent(tmp_path, engine):
+    """Records living in BOTH the snapshot and the WAL tail replay as
+    no-ops — recovery applies LWW verbs, not blind inserts."""
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    _fill(engine, st, 30)
+    st.snapshot_now()
+    # More writes after the snapshot (land in the fresh segment).
+    ts = time.time_ns() + 10_000
+    engine.set_with_ts(b"post", b"snap", ts)
+    st.record_set(b"post", b"snap", ts)
+    expect_root = engine.merkle_root().hex()
+    st.stop()
+
+    eng2 = NativeEngine("mem")
+    try:
+        st2 = DurableStore(eng2, _cfg(), d)
+        rep = st2.recover()
+        assert rep.snapshot_items == 30
+        assert eng2.merkle_root().hex() == expect_root
+        assert eng2.dbsize() == 31
+        st2.stop()
+    finally:
+        eng2.close()
+
+
+def test_torn_tail_recovery_stops_at_last_whole_record(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    _fill(engine, st, 10)
+    st.stop()
+    seg = walmod.list_segments(d)[-1][1]
+    truncate_file(seg, os.path.getsize(seg) - 5)  # tear the final frame
+
+    eng2 = NativeEngine("mem")
+    try:
+        st2 = DurableStore(eng2, _cfg(), d)
+        rep = st2.recover()
+        assert rep.torn_tail
+        assert rep.replayed == 9
+        assert eng2.get(b"k0008") == b"v-8"
+        assert eng2.get(b"k0009") is None
+        # The reopened writer cut the tear: appends extend a clean log.
+        ts = time.time_ns()
+        st2.record_set(b"new", b"write", ts)
+        st2.stop()
+    finally:
+        eng2.close()
+    scan = walmod.scan_segment(seg)
+    assert scan.clean
+    assert scan.records[-1].key == b"new"
+
+
+def test_strict_mode_refuses_on_root_mismatch(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    _fill(engine, st, 20)
+    st.snapshot_now()
+    st.stop()
+    # Tamper with the stamp itself: rewrite the snapshot with a bogus root
+    # (content + CRC stay valid, so only root verification can catch it).
+    seq, path = snapmod.list_snapshots(d)[-1]
+    snap = snapmod.read_snapshot(path)
+    os.unlink(path)
+    snapmod.write_snapshot(
+        d, seq, snap.items, snap.tombstones, snap.wal_seq, "ab" * 32
+    )
+
+    eng2 = NativeEngine("mem")
+    try:
+        with pytest.raises(RecoveryError, match="walcheck"):
+            DurableStore(eng2, _cfg(verify="strict"), d).recover()
+    finally:
+        eng2.close()
+
+
+def test_repair_mode_falls_back_to_older_snapshot(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(snapshots_retained=2), d)
+    st.recover()
+    _fill(engine, st, 20)
+    st.snapshot_now()  # snapshot 1: 20 items
+    ts = time.time_ns() + 5_000
+    engine.set_with_ts(b"later", b"write", ts)
+    st.record_set(b"later", b"write", ts)
+    expect_root = engine.merkle_root().hex()
+    st.snapshot_now()  # snapshot 2: 21 items
+    st.stop()
+    m0 = get_metrics().snapshot()["counters"].get(
+        "storage.recovery_root_mismatch", 0
+    )
+    corrupt_file(snapmod.list_snapshots(d)[-1][1], 60)  # kill the newest
+
+    eng2 = NativeEngine("mem")
+    try:
+        st2 = DurableStore(eng2, _cfg(), d)
+        rep = st2.recover()
+        assert rep.snapshots_rejected
+        assert rep.snapshot_items == 20  # older snapshot carried the load
+        # The WAL tail behind the older snapshot replays the rest.
+        assert eng2.get(b"later") == b"write"
+        assert eng2.merkle_root().hex() == expect_root
+        st2.stop()
+    finally:
+        eng2.close()
+    after = get_metrics().snapshot()["counters"]
+    assert after.get("storage.recovery_root_mismatch", 0) > m0
+
+
+def test_interior_corruption_requests_reanchor_snapshot(tmp_path, engine):
+    """Repair-mode recovery past interior WAL corruption must request a
+    prompt snapshot: otherwise every future recovery replays up to the same
+    bad segment and skips everything after it — including all
+    post-recovery writes — until the byte trigger fires."""
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(segment_bytes=512), d)
+    st.recover()
+    _fill(engine, st, 40)  # spans several 512-byte segments
+    st.stop()
+    segs = walmod.list_segments(d)
+    assert len(segs) >= 3
+    # Interior corruption in the SECOND segment (not the tail): segment 0
+    # replays fully, everything from the bad byte onward is skipped.
+    corrupt_file(segs[1][1], 40)
+
+    eng2 = NativeEngine("mem")
+    try:
+        st2 = DurableStore(eng2, _cfg(), d)
+        rep = st2.recover()
+        assert rep.corruption is not None
+        assert rep.replayed > 0  # the clean prefix landed
+        assert st2._snapshot_requested  # ticker will re-anchor promptly
+        st2.snapshot_now()  # what the ticker does
+        post_root = eng2.merkle_root().hex()
+        st2.stop()
+    finally:
+        eng2.close()
+
+    # The re-anchored state survives the NEXT recovery bit-exactly (the
+    # bad segment no longer gates replay).
+    eng3 = NativeEngine("mem")
+    try:
+        st3 = DurableStore(eng3, _cfg(), d)
+        rep3 = st3.recover()
+        assert rep3.corruption is None
+        assert eng3.merkle_root().hex() == post_root
+        st3.stop()
+    finally:
+        eng3.close()
+
+
+def test_lock_rejects_second_owner(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    eng2 = NativeEngine("mem")
+    try:
+        with pytest.raises(StorageLockedError):
+            DurableStore(eng2, _cfg(), d)
+    finally:
+        eng2.close()
+    st.stop()
+    # Released on stop: a successor may take the directory.
+    eng3 = NativeEngine("mem")
+    try:
+        st3 = DurableStore(eng3, _cfg(), d)
+        st3.recover()
+        st3.stop()
+    finally:
+        eng3.close()
+
+
+def test_record_raw_event_mapping(tmp_path, engine):
+    """Drained native events map onto WAL records: value-carrying ops
+    journal the POST-op value as a timestamped SET, deletes journal the
+    tombstone ts, TRUNCATE journals the wipe."""
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    ts = time.time_ns()
+    raws = [
+        ChangeEventRaw(OP_SET, True, ts + 1, 1, b"a", b"1"),
+        ChangeEventRaw(OP_INCR, True, ts + 2, 2, b"ctr", b"5"),
+        ChangeEventRaw(OP_DEL, False, ts + 3, 3, b"a", b""),
+        ChangeEventRaw(OP_TRUNCATE, False, ts + 4, 4, b"", b""),
+        ChangeEventRaw(OP_SET, True, ts + 5, 5, b"b", b"2"),
+    ]
+    st.record_raw(raws)
+    st.stop()
+    scan = walmod.scan_segment(walmod.list_segments(d)[0][1])
+    assert [r.op for r in scan.records] == [
+        walmod.OP_SET,
+        walmod.OP_SET,
+        walmod.OP_DEL,
+        walmod.OP_TRUNCATE,
+        walmod.OP_SET,
+    ]
+    eng2 = NativeEngine("mem")
+    try:
+        DurableStore(eng2, _cfg(), d).recover()
+        # Everything before the TRUNCATE is gone; only b survives.
+        assert eng2.scan() == [b"b"]
+    finally:
+        eng2.close()
+
+
+def test_compaction_retention(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(
+        engine, _cfg(snapshots_retained=2, segment_bytes=512), d
+    )
+    st.recover()
+    for round_ in range(3):
+        _fill(engine, st, 40, base_ts=time.time_ns())
+        st.compact()
+    snaps = snapmod.list_snapshots(d)
+    assert len(snaps) == 2  # retention pruned the oldest
+    oldest_needed = min(
+        snapmod.read_snapshot(p).wal_seq for _, p in snaps
+    )
+    assert all(s >= oldest_needed for s, _ in walmod.list_segments(d))
+    expect_root = engine.merkle_root().hex()
+    st.stop()
+    eng2 = NativeEngine("mem")
+    try:
+        st2 = DurableStore(eng2, _cfg(), d)
+        st2.recover()
+        assert eng2.merkle_root().hex() == expect_root
+        st2.stop()
+    finally:
+        eng2.close()
+
+
+def test_metrics_counters(tmp_path, engine):
+    before = get_metrics().snapshot()["counters"]
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    _fill(engine, st, 15)
+    st.snapshot_now()
+    ts = time.time_ns() + 1_000
+    engine.set_with_ts(b"tail", b"record", ts)
+    st.record_set(b"tail", b"record", ts)  # replays from the WAL tail
+    st.stop()
+    eng2 = NativeEngine("mem")
+    try:
+        DurableStore(eng2, _cfg(), d).recover()
+    finally:
+        eng2.close()
+    after = get_metrics().snapshot()
+    c = after["counters"]
+
+    def grew(name, by=1):
+        return c.get(name, 0) >= before.get(name, 0) + by
+
+    assert grew("storage.wal_appends", 15)
+    assert grew("storage.wal_fsyncs", 1)
+    assert grew("storage.snapshots", 1)
+    assert grew("storage.recovery_replayed", 1)
+    assert grew("storage.recoveries", 2)
+    assert "storage.snapshot" in after["spans"]  # snapshot_seconds source
+    assert "storage.recovery" in after["spans"]
+
+
+def test_walcheck_clean_dir_and_replay_root(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    ts0 = _fill(engine, st, 25)
+    engine.delete_with_ts(b"k0003", ts0 + 90)
+    st.record_delete(b"k0003", ts0 + 90)
+    st.snapshot_now()
+    expect_root = engine.merkle_root().hex()
+    st.stop()
+
+    report = check_dir(d)
+    assert not report["errors"] and not report["warnings"]
+    assert report["replay_root"] == expect_root
+    assert report["live_keys"] == 24
+    assert replay_root_hex(d) == expect_root
+
+
+def test_walcheck_flags_torn_tail_as_warning_and_compacts(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    _fill(engine, st, 12)
+    st.stop()
+    seg = walmod.list_segments(d)[-1][1]
+    truncate_file(seg, os.path.getsize(seg) - 4)
+
+    report = check_dir(d)
+    assert not report["errors"]  # torn tail is recoverable, not fatal
+    assert any("torn tail" in w for w in report["warnings"])
+    assert report["live_keys"] == 11
+
+    # Offline compaction rewrites to one verified snapshot + empty WAL.
+    from merklekv_tpu.storage.walcheck import main as walcheck_main
+
+    assert walcheck_main([d, "--compact"]) == 0
+    assert len(snapmod.list_snapshots(d)) == 1
+    assert walmod.list_segments(d) == []
+    eng2 = NativeEngine("mem")
+    try:
+        st2 = DurableStore(eng2, _cfg(), d)
+        rep = st2.recover()
+        assert rep.snapshot_items == 11
+        assert eng2.get(b"k0010") == b"v-10"
+        st2.stop()
+    finally:
+        eng2.close()
+
+
+def test_replication_writes_reach_the_wal(tmp_path):
+    """With replication enabled the Replicator owns the event-queue drain;
+    local writes must reach the WAL through its batch listener and REMOTE
+    applies through the storage hook — both survive recovery."""
+    import uuid
+
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.cluster.transport import TcpBroker
+    from merklekv_tpu.config import Config
+    from merklekv_tpu.native_bindings import NativeServer
+
+    broker = TcpBroker()
+    topic = f"st-{uuid.uuid4().hex[:8]}"
+    nodes = []
+    try:
+        for i in (1, 2):
+            eng = NativeEngine("mem")
+            srv = NativeServer(eng, "127.0.0.1", 0)
+            srv.start()
+            cfg = Config()
+            cfg.replication.enabled = True
+            cfg.replication.mqtt_broker = broker.host
+            cfg.replication.mqtt_port = broker.port
+            cfg.replication.topic_prefix = topic
+            cfg.replication.client_id = f"n{i}"
+            cfg.anti_entropy.engine = "cpu"  # no device mirror in this test
+            store = DurableStore(eng, _cfg(), str(tmp_path / f"n{i}"))
+            store.recover()
+            node = ClusterNode(cfg, eng, srv, storage=store)
+            node.start()
+            client = MerkleKVClient("127.0.0.1", srv.port).connect()
+            nodes.append((eng, srv, store, node, client))
+
+        c1, c2 = nodes[0][4], nodes[1][4]
+        c1.set("local-write", "from-n1")
+        deadline = time.time() + 5
+        while time.time() < deadline and c2.get("local-write") != "from-n1":
+            time.sleep(0.01)
+        assert c2.get("local-write") == "from-n1"
+        roots = [eng.merkle_root().hex() for eng, *_ in nodes]
+        assert roots[0] == roots[1]
+    finally:
+        dirs = []
+        for eng, srv, store, node, client in nodes:
+            client.close()
+            node.stop()
+            store.stop()
+            dirs.append(store.directory)
+            srv.close()
+            eng.close()
+        broker.close()
+
+    # n1 journaled its local write (batch listener), n2 its remote apply
+    # (storage hook inside the Replicator) — both recover to the same root.
+    for d in dirs:
+        eng = NativeEngine("mem")
+        try:
+            st = DurableStore(eng, _cfg(), d)
+            st.recover()
+            assert eng.get(b"local-write") == b"from-n1"
+            assert eng.merkle_root().hex() == roots[0]
+            st.stop()
+        finally:
+            eng.close()
+
+
+def test_walcheck_flags_root_mismatch_as_error(tmp_path, engine):
+    d = str(tmp_path / "node")
+    st = DurableStore(engine, _cfg(), d)
+    st.recover()
+    _fill(engine, st, 10)
+    st.snapshot_now()
+    st.stop()
+    seq, path = snapmod.list_snapshots(d)[-1]
+    snap = snapmod.read_snapshot(path)
+    os.unlink(path)
+    snapmod.write_snapshot(
+        d, seq, snap.items, snap.tombstones, snap.wal_seq, "cd" * 32
+    )
+    from merklekv_tpu.storage.walcheck import main as walcheck_main
+
+    report = check_dir(d)
+    assert any("root mismatch" in e for e in report["errors"])
+    assert walcheck_main([d]) == 1
