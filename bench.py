@@ -25,7 +25,9 @@ The remaining BASELINE.json configs print one JSON line each on STDERR
     axis, reduced n on one chip; the virtual-mesh dryrun covers the
     multi-device program);
   - op_latency_us: client-observed SET/GET p50/p99 against the embedded
-    native server over localhost TCP.
+    native server over localhost TCP;
+  - sync_wire_bytes_1key: anti-entropy transfer cost for 1 divergent key
+    (subtree-bisection walk vs paged hash scan, bytes + wall time).
 
 Off-TPU the sizes shrink to smoke-test values so the script stays runnable
 in CI; the driver's real run happens on the chip.
@@ -285,6 +287,58 @@ def bench_incremental_rehash(n_tree: int, batch: int, batches: int) -> dict:
     }
 
 
+def bench_sync_wire_bytes(n_keys: int) -> dict:
+    """Sync wire-byte accounting: 1 divergent key in n_keys, subtree-
+    bisection walk vs paged hash scan — client-counted wire bytes and wall
+    time for each. The walk's bytes scale with divergence·log n (TREELEVEL
+    descent + one bounded leaf page + one value); the hash scan ships the
+    digest list for the whole keyspace, O(n·32 B) — ~320 MB of digests at
+    the ROADMAP's 10M-key north-star for a single divergent key."""
+    from merklekv_tpu.cluster.sync import SyncManager
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    eng_a = NativeEngine("mem")
+    eng_b = NativeEngine("mem")
+    srv_a = NativeServer(eng_a, "127.0.0.1", 0)
+    srv_a.start()
+    try:
+        for i in range(n_keys):
+            k = b"wb:%08d" % i
+            v = b"val-%d" % (i % 9973)
+            eng_a.set(k, v)
+            eng_b.set(k, v)
+
+        def one(mode: str) -> tuple[int, float]:
+            # Re-diverge exactly one key, then time one repair cycle.
+            eng_b.set(
+                b"wb:%08d" % (n_keys // 2), b"DIVERGED-" + mode.encode()
+            )
+            mgr = SyncManager(eng_b, mode=mode)
+            t0 = time.perf_counter()
+            rep = mgr.sync_once("127.0.0.1", srv_a.port)
+            dt = time.perf_counter() - t0
+            assert rep.divergent >= 1 and rep.set_keys >= 1
+            return rep.bytes_sent + rep.bytes_received, dt
+
+        walk_bytes, walk_s = one("bisect")
+        page_bytes, page_s = one("page")
+        return {
+            "metric": "sync_wire_bytes_1key",
+            "value": walk_bytes,
+            "unit": "bytes (bisect walk)",
+            "n": n_keys,
+            "walk_bytes": walk_bytes,
+            "walk_ms": round(walk_s * 1e3, 1),
+            "hash_paged_bytes": page_bytes,
+            "hash_paged_ms": round(page_s * 1e3, 1),
+            "reduction_x": round(page_bytes / max(walk_bytes, 1), 1),
+        }
+    finally:
+        srv_a.close()
+        eng_a.close()
+        eng_b.close()
+
+
 def bench_op_latency(n_ops: int) -> dict:
     """Client-observed op latency: SET/GET p50/p99 over localhost TCP
     against the embedded native server (the reference's test_benchmark.py
@@ -446,6 +500,12 @@ def _run(backend: str) -> None:
         configs.append(bench_op_latency(n_ops=10_000 if on_tpu else 1_000))
     except Exception as e:
         print(f"# op_latency bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(
+            bench_sync_wire_bytes(n_keys=(1 << 20) if on_tpu else (1 << 14))
+        )
+    except Exception as e:
+        print(f"# sync_wire_bytes bench failed: {e!r}", file=sys.stderr)
 
     for cfg in configs:
         cfg["backend"] = backend

@@ -94,6 +94,12 @@ class MerkleKVClient:
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._reader = _ResponseReader()
+        # Wire-byte accounting (requests sent / response bytes received over
+        # the connection's lifetime, reconnects included). The sync manager
+        # reads deltas of these to report anti-entropy transfer cost — the
+        # number the bisection walk exists to shrink.
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     # -- lifecycle ---------------------------------------------------------
     def connect(self) -> "MerkleKVClient":
@@ -133,10 +139,12 @@ class MerkleKVClient:
     def _send_line(self, line: str) -> None:
         if self._sock is None:
             raise ConnectionError("not connected; call connect() first")
+        payload = line.encode("utf-8") + b"\r\n"
         try:
-            self._sock.sendall(line.encode("utf-8") + b"\r\n")
+            self._sock.sendall(payload)
         except OSError as e:
             raise ConnectionError(f"send failed: {e}") from e
+        self.bytes_sent += len(payload)
 
     def _read_line(self) -> str:
         while True:
@@ -151,6 +159,7 @@ class MerkleKVClient:
                 raise ConnectionError(f"recv failed: {e}") from e
             if not data:
                 raise ConnectionError("server closed connection")
+            self.bytes_received += len(data)
             self._reader.feed(data)
 
     def _request(self, line: str) -> str:
@@ -271,15 +280,28 @@ class MerkleKVClient:
         return out
 
     def leaf_hashes_page(
-        self, count: int, after: str = ""
+        self, count: int, after: str = "", upto: Optional[str] = None
     ) -> tuple[list[tuple[str, Optional[str], int]], bool]:
         """One page of the cursor-paged hash scan (HASHPAGE): up to
         ``count`` (key, digest hex | None, ts) rows for keys strictly after
         ``after``, in sorted key order — tombstones (digest None) merged in
         place, unlike LEAFHASHES which groups them at the end. Returns
         ``(rows, done)``; ``done`` means the keyspace is exhausted. Order is
-        preserved because the last row's key is the caller's next cursor."""
-        cmd = f"HASHPAGE {count} {after}" if after else f"HASHPAGE {count}"
+        preserved because the last row's key is the caller's next cursor.
+
+        ``upto`` (exclusive upper bound, requires a non-empty ``after``)
+        makes the page range-bounded — the bisection walk's leaf fetch for
+        one divergent key range; ``done`` then means the RANGE is
+        exhausted. The wire form cannot express an empty cursor with a
+        bound, so callers starting at the keyspace head trim client-side."""
+        if upto is not None and not after:
+            raise ValueError("bounded HASHPAGE requires a non-empty cursor")
+        if upto is not None:
+            cmd = f"HASHPAGE {count} {after} {upto}"
+        elif after:
+            cmd = f"HASHPAGE {count} {after}"
+        else:
+            cmd = f"HASHPAGE {count}"
         n = _count_after(self._request(cmd), "HASHES ")
         rows: list[tuple[str, Optional[str], int]] = []
         for _ in range(n):
@@ -303,6 +325,40 @@ class MerkleKVClient:
                 ) from e
             rows.append((parts[0], digest, ts))
         return rows, n < count
+
+    def tree_level(
+        self, level: int, lo: int, hi: int
+    ) -> tuple[list[tuple[int, str]], int]:
+        """Interior digests of the server's reference Merkle tree
+        (TREELEVEL): ``(idx, digest hex)`` rows for level ``level``
+        (0 = leaves), indices ``[lo, hi)`` clamped to the level's size,
+        plus the live leaf count ``n`` (which fixes every level's size:
+        ``m_0 = n``, ``m_{l+1} = (m_l + 1) // 2``). ``lo == hi`` is the
+        zero-cost capability probe + leaf-count fetch the bisection walk
+        opens with."""
+        resp = _parse_simple(self._request(f"TREELEVEL {level} {lo} {hi}"))
+        if not resp.startswith("NODES "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        try:
+            count_s, n_s = resp[6:].split(" ")
+            count, n = int(count_s), int(n_s)
+        except ValueError as e:
+            raise ProtocolError(f"unexpected response: {resp}") from e
+        rows: list[tuple[int, str]] = []
+        for _ in range(count):
+            line = self._read_line()
+            idx_s, _, hexd = line.partition(" ")
+            try:
+                idx = int(idx_s)
+                # Exactly 32 digest bytes: bytes.fromhex("") succeeds, so a
+                # truncated row would otherwise slip through as an empty
+                # digest and make the walk chase a phantom divergence.
+                if len(bytes.fromhex(hexd)) != 32:
+                    raise ValueError("digest must be 32 bytes")
+            except ValueError as e:
+                raise ProtocolError(f"malformed TREELEVEL row: {line!r}") from e
+            rows.append((idx, hexd))
+        return rows, n
 
     # -- admin ---------------------------------------------------------------
     def ping(self, message: str = "") -> str:
@@ -427,6 +483,7 @@ class MerkleKVClient:
             raise ConnectionError("not connected")
         payload = "".join(c + "\r\n" for c in cmds).encode("utf-8")
         self._sock.sendall(payload)
+        self.bytes_sent += len(payload)
         return [self._read_line() for _ in cmds]
 
 
@@ -444,6 +501,9 @@ class AsyncMerkleKVClient:
         self.timeout = timeout
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
+        # Wire-byte accounting, mirroring the sync client.
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     async def connect(self) -> "AsyncMerkleKVClient":
         try:
@@ -479,7 +539,9 @@ class AsyncMerkleKVClient:
     async def _request(self, line: str) -> str:
         if self._writer is None:
             raise ConnectionError("not connected")
-        self._writer.write(line.encode("utf-8") + b"\r\n")
+        payload = line.encode("utf-8") + b"\r\n"
+        self._writer.write(payload)
+        self.bytes_sent += len(payload)
         await self._writer.drain()
         return await self._read_line()
 
@@ -487,6 +549,7 @@ class AsyncMerkleKVClient:
         raw = await asyncio.wait_for(self._reader.readline(), self.timeout)
         if not raw:
             raise ConnectionError("server closed connection")
+        self.bytes_received += len(raw)
         return raw.rstrip(b"\r\n").decode("utf-8", "surrogateescape")
 
     async def get(self, key: str) -> Optional[str]:
@@ -525,13 +588,20 @@ class AsyncMerkleKVClient:
         return resp.rsplit(" ", 1)[-1]
 
     async def leaf_hashes_page(
-        self, count: int, after: str = ""
+        self, count: int, after: str = "", upto: Optional[str] = None
     ) -> tuple[list[tuple[str, Optional[str], int]], bool]:
         """Async HASHPAGE — same semantics as the sync client's
         ``leaf_hashes_page``: up to ``count`` (key, digest hex | None, ts)
         rows strictly after ``after`` in sorted order; ``done`` means the
-        keyspace is exhausted."""
-        cmd = f"HASHPAGE {count} {after}" if after else f"HASHPAGE {count}"
+        keyspace (or, with ``upto``, the bounded range) is exhausted."""
+        if upto is not None and not after:
+            raise ValueError("bounded HASHPAGE requires a non-empty cursor")
+        if upto is not None:
+            cmd = f"HASHPAGE {count} {after} {upto}"
+        elif after:
+            cmd = f"HASHPAGE {count} {after}"
+        else:
+            cmd = f"HASHPAGE {count}"
         n = _count_after(await self._request(cmd), "HASHES ")
         rows: list[tuple[str, Optional[str], int]] = []
         for _ in range(n):
@@ -552,6 +622,34 @@ class AsyncMerkleKVClient:
             rows.append((parts[0], digest, ts))
         return rows, n < count
 
+    async def tree_level(
+        self, level: int, lo: int, hi: int
+    ) -> tuple[list[tuple[int, str]], int]:
+        """Async TREELEVEL — same semantics as the sync client's
+        ``tree_level``."""
+        resp = _parse_simple(
+            await self._request(f"TREELEVEL {level} {lo} {hi}")
+        )
+        if not resp.startswith("NODES "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        try:
+            count_s, n_s = resp[6:].split(" ")
+            count, n = int(count_s), int(n_s)
+        except ValueError as e:
+            raise ProtocolError(f"unexpected response: {resp}") from e
+        rows: list[tuple[int, str]] = []
+        for _ in range(count):
+            line = await self._read_line()
+            idx_s, _, hexd = line.partition(" ")
+            try:
+                idx = int(idx_s)
+                if len(bytes.fromhex(hexd)) != 32:
+                    raise ValueError("digest must be 32 bytes")
+            except ValueError as e:
+                raise ProtocolError(f"malformed TREELEVEL row: {line!r}") from e
+            rows.append((idx, hexd))
+        return rows, n
+
     async def ping(self, message: str = "") -> str:
         cmd = f"PING {message}" if message else "PING"
         return _parse_simple(await self._request(cmd))
@@ -566,6 +664,8 @@ class AsyncMerkleKVClient:
         cmds = list(commands)
         if self._writer is None:
             raise ConnectionError("not connected")
-        self._writer.write("".join(c + "\r\n" for c in cmds).encode("utf-8"))
+        payload = "".join(c + "\r\n" for c in cmds).encode("utf-8")
+        self._writer.write(payload)
+        self.bytes_sent += len(payload)
         await self._writer.drain()
         return [await self._read_line() for _ in cmds]

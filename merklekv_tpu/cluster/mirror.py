@@ -179,6 +179,15 @@ class DeviceTreeMirror:
                 self._state = self._load_state()
             return self._state.root_hex()
 
+    def level_nodes(self, level: int, lo: int, hi: int):
+        """TREELEVEL slice from the device-resident tree: reference-level
+        ``(idx, digest)`` rows plus the leaf count, or None while the state
+        is not built (the native host fallback answers instead)."""
+        with self._mu:
+            if self._closed or self._state is None:
+                return None
+            return self._state.level_nodes(level, lo, hi)
+
     @property
     def state(self):
         return self._state

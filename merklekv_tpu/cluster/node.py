@@ -45,6 +45,8 @@ class ClusterNode:
             device=cfg.anti_entropy.engine,
             repair_listener=self._on_sync_repair,
             on_peer_degraded=self._on_peer_degraded,
+            mode=cfg.anti_entropy.mode,
+            bisect_threshold=cfg.anti_entropy.bisect_threshold,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -206,10 +208,13 @@ class ClusterNode:
             else:
                 storage.record_set(key, value, ts)
 
-    def device_root_hex(self) -> Optional[str]:
-        """Whole-keyspace Merkle root from the live device tree, or None
-        when the mirror isn't ready (replication off / device disabled /
-        still warming — the native path answers meanwhile)."""
+    def _query_ready_mirror(self, fn):
+        """Shared gate for device-tree reads (HASH root, TREELEVEL slices):
+        returns ``fn(mirror)`` after flushing staged events through the
+        replicator (read-your-writes), or None whenever the device path
+        can't answer — replication off, device disabled, mirror still
+        warming (a warm-up is kicked off), or any device failure — so the
+        native fallback serves instead and nothing stalls on the device."""
         with self._rep_mu:
             rep, mirror = self._replicator, self._mirror
         if rep is None or mirror is None:
@@ -218,10 +223,25 @@ class ClusterNode:
             mirror.start_warming()  # no-op if already in flight
             return None
         try:
-            rep.flush()  # read-your-writes: drain staged events first
-            return mirror.root_hex()
+            rep.flush()  # serve root-consistent state: drain staged events
+            return fn(mirror)
         except Exception:
             return None  # native fallback answers instead
+
+    def device_tree_level(self, level: int, lo: int, hi: int):
+        """TREELEVEL answer from the live device tree: ``(rows, n)`` with
+        reference-level ``(idx, digest)`` rows, or None when the mirror
+        isn't ready (the native server's host-side cached tree answers
+        meanwhile, so peers' walks never stall on a warming mirror)."""
+        return self._query_ready_mirror(
+            lambda m: m.level_nodes(level, lo, hi)
+        )
+
+    def device_root_hex(self) -> Optional[str]:
+        """Whole-keyspace Merkle root from the live device tree, or None
+        when the mirror isn't ready (replication off / device disabled /
+        still warming — the native path answers meanwhile)."""
+        return self._query_ready_mirror(lambda m: m.root_hex())
 
     @property
     def health(self):
@@ -267,6 +287,18 @@ class ClusterNode:
             # incremental tree; empty answer falls back to the native path.
             root = self.device_root_hex()
             return f"HASH {root}\r\n" if root is not None else None
+        if parts[0] == "TREELEVEL":
+            # Bisection-walk node fetch served from the device-resident
+            # tree (one batched device gather per request); empty answer
+            # falls back to the native server's cached host tree.
+            out = self.device_tree_level(
+                int(parts[1]), int(parts[2]), int(parts[3])
+            )
+            if out is None:
+                return None
+            rows, n = out
+            body = "".join(f"{i} {d.hex()}\r\n" for i, d in rows)
+            return f"NODES {len(rows)} {n}\r\n{body}"
         if parts[0] == "SYNC":
             host, port = parts[1], int(parts[2])
             try:

@@ -83,10 +83,21 @@ class SyncReport:
     set_keys: int = 0
     deleted_keys: int = 0
     values_fetched: int = 0  # values transferred (== divergent when hash-first)
-    mode: str = ""  # "noop" | "hash-paged" | "hash-first" | "full" | "full-fallback"
+    # "noop" | "bisect" | "hash-paged" | "hash-first" | "full" |
+    # "full-fallback"
+    mode: str = ""
     verified: Optional[bool] = None  # post-sync root recheck (--verify)
     resumed: bool = False  # this cycle continued an interrupted session
     seconds: float = 0.0
+    # Wire cost of the whole cycle (client-measured request/response bytes,
+    # reconnects included) — the number the bisection walk shrinks from
+    # O(n) to O(divergence·log n).
+    bytes_sent: int = 0
+    bytes_received: int = 0
+    # Bisection-walk observability: tree nodes compared and walk rounds
+    # (one round per level batch of TREELEVEL fetches).
+    nodes_compared: int = 0
+    rounds: int = 0
     details: list[str] = field(default_factory=list)
 
 
@@ -118,6 +129,11 @@ class SyncSession:
     # link); clean pages grow it back toward the configured maximum.
     # 0 = start from the SyncManager default.
     page_size: int = 0
+    # The interrupted cycle was a bisection walk: resume re-enters the walk
+    # (clipping already-verified intervals at the cursor) instead of the
+    # paged scan — mode is sticky across a resume so a hostile link can't
+    # silently downgrade the transfer strategy.
+    walk: bool = False
     created_unix: float = field(default_factory=time.time)
 
 
@@ -185,10 +201,18 @@ class SyncManager:
         retry: Optional[RetryPolicy] = None,
         on_peer_degraded: Optional[Callable[[str, str], None]] = None,
         hash_page: int = 512,
+        mode: str = "auto",
+        bisect_threshold: int = 8192,
     ) -> None:
         self._engine = engine
         self._device = device
         self._mget_batch = mget_batch
+        # Pairwise transfer strategy when roots differ: "auto" bisects the
+        # tree (TREELEVEL walk) once the local keyspace reaches
+        # bisect_threshold keys and pages below it; "bisect"/"page" force a
+        # strategy. A peer without TREELEVEL always degrades to paging.
+        self._mode = mode
+        self._bisect_threshold = bisect_threshold
         # Keys per HASHPAGE fetch in the paged pairwise walk. Smaller pages
         # bound how much verified progress one dead stream can destroy (a
         # page is the resume granularity); larger pages amortize round
@@ -243,6 +267,7 @@ class SyncManager:
         attempts: int = 0,
         cursor: bytes = b"",
         page_size: int = 0,
+        walk: bool = False,
     ) -> None:
         self._sessions[peer] = SyncSession(
             peer=peer,
@@ -251,6 +276,7 @@ class SyncManager:
             attempts=attempts,
             cursor=cursor,
             page_size=page_size,
+            walk=walk,
             created_unix=self._session_born.setdefault(peer, time.time()),
         )
         get_metrics().inc("anti_entropy.sessions_checkpointed")
@@ -343,6 +369,7 @@ class SyncManager:
                             deadline, lww=True,
                             already_repaired=sess.repaired,
                             prior_attempts=sess.attempts, cursor=sess.cursor,
+                            walk=sess.walk,
                         )
                         sess.pending_sets = []
                         if peer in self._sessions:
@@ -391,19 +418,30 @@ class SyncManager:
                         report.mode = "full"
                         self._sync_full(client, report)
                     else:
-                        # Paged walk first: each HASHPAGE covers one small
-                        # key range, repaired before the next is fetched,
-                        # so a killed stream loses at most one page of
-                        # progress.
-                        paged = self._sync_hash_paged(
+                        start = sess.cursor if sess is not None else b""
+                        prior = sess.attempts if sess is not None else 0
+                        # Subtree bisection first (mode permitting): walk
+                        # the peer's tree top-down, descend only into
+                        # divergent subtrees, and fetch leaf hashes +
+                        # values for divergent key ranges only — wire
+                        # bytes ∝ divergence·log n, not keyspace size.
+                        walked, precomputed = False, None
+                        if self._want_walk(sess):
+                            walked, precomputed = self._sync_bisect(
+                                client, report, deadline,
+                                start=start, prior_attempts=prior,
+                                start_page=(
+                                    sess.page_size if sess is not None else 0
+                                ),
+                            )
+                        paged = walked or self._sync_hash_paged(
                             client, report, deadline,
-                            start=sess.cursor if sess is not None else b"",
-                            prior_attempts=(
-                                sess.attempts if sess is not None else 0
-                            ),
+                            start=start,
+                            prior_attempts=prior,
                             start_page=(
                                 sess.page_size if sess is not None else 0
                             ),
+                            precomputed=precomputed,
                         )
                         if not paged:
                             # Peer predates HASHPAGE: monolithic
@@ -479,6 +517,13 @@ class SyncManager:
                         f"(peer {report.peer})"
                     )
         finally:
+            # Wire-byte accounting for the WHOLE cycle (probe, hash/tree
+            # fetches, repairs, reconnects — the client counters survive
+            # reconnects because the same client object re-dials).
+            report.bytes_sent = client.bytes_sent
+            report.bytes_received = client.bytes_received
+            get_metrics().inc("sync.bytes_sent", report.bytes_sent)
+            get_metrics().inc("sync.bytes_received", report.bytes_received)
             client.close()
             self._session_done(peer)
 
@@ -486,7 +531,406 @@ class SyncManager:
         self.last_report = report
         return report
 
-    # -- paged hash walk (primary pairwise path) ------------------------------
+    # -- subtree-bisection walk (large-keyspace pairwise path) ----------------
+    # Frontier cap: past this many divergent nodes per level the descent
+    # stops early and repairs coarser intervals — massive divergence makes
+    # deeper bisection pure overhead (the leaf fetches dominate anyway).
+    _MAX_WALK_FRONTIER = 2048
+    # Finest subtree the descent isolates before switching to leaf pages.
+    # One more level costs ~134 wire bytes per divergent range (two more
+    # interior digests) and saves half the range's leaf rows (~95 bytes
+    # each), so descending pays until the span is a handful of keys; 16
+    # keeps the last hop cheap without a round trip per single leaf.
+    _WALK_LEAF_SPAN = 16
+
+    def _want_walk(self, sess: Optional[SyncSession]) -> bool:
+        """Transfer-strategy selection for this cycle. A mid-walk resume
+        stays in its recorded mode (the checkpointed cursor's semantics
+        depend on it); otherwise config decides, with "auto" bisecting only
+        once the keyspace is large enough that a tree walk's extra round
+        trips beat shipping the whole hash list."""
+        if sess is not None and sess.cursor:
+            return sess.walk
+        if self._mode == "page":
+            return False
+        if self._mode == "bisect":
+            return True
+        try:
+            return self._engine.dbsize() >= self._bisect_threshold
+        except Exception:
+            return False
+
+    def _sync_bisect(
+        self,
+        client: MerkleKVClient,
+        report: SyncReport,
+        deadline: Optional[Deadline],
+        start: bytes,
+        prior_attempts: int = 0,
+        start_page: int = 0,
+    ) -> tuple[bool, Optional[tuple[list[bytes], dict[bytes, bytes]]]]:
+        """Top-down Merkle walk: start at the peer's tree root, descend
+        only into divergent subtrees (TREELEVEL fetches, one batch per
+        level), then repair each divergent LEAF RANGE with range-bounded
+        HASHPAGE pages + targeted MGET — so wire bytes scale with
+        divergence·log n instead of keyspace size. Positional node
+        comparison is exact for value divergence; a structural change
+        (insert/delete) shifts every position to its right, so those
+        subtrees all read divergent and collapse into one contiguous
+        repair range — never worse than the hash-list transfer, and the
+        repair itself stays key-based (bounded pages), so it is correct
+        either way.
+
+        Boundary-key invariant the leaf fetch relies on: a node that
+        COMPARES EQUAL pins keys and positions — local position i then
+        holds exactly the remote's key i for every position the node
+        covers — so divergent ranges are bounded by locally-known keys.
+
+        Returns ``(walked, local_precomputed)``: ``walked`` False when the
+        peer can't serve TREELEVEL (or is empty, or its keyspace churned
+        mid-walk) — the caller degrades to the paged hash scan, handing it
+        the already-computed (keys, leaf hashes) so the fallback doesn't
+        re-hash the keyspace this cycle. Transport errors checkpoint
+        (cursor, walk=True) and propagate, exactly like the paged walk, so
+        the reconnect loop and cross-cycle resume machinery apply
+        unchanged."""
+        peer = report.peer
+        with span("anti_entropy.walk", peer=peer) as rec:
+            out, precomputed = self._sync_bisect_inner(
+                client, report, deadline, start, prior_attempts, start_page
+            )
+            rec["walked"] = out
+            rec["rounds"] = report.rounds
+            rec["nodes_compared"] = report.nodes_compared
+            rec["divergent"] = report.divergent
+            return out, precomputed
+
+    def _sync_bisect_inner(
+        self,
+        client: MerkleKVClient,
+        report: SyncReport,
+        deadline: Optional[Deadline],
+        start: bytes,
+        prior_attempts: int,
+        start_page: int,
+    ) -> tuple[bool, Optional[tuple[list[bytes], dict[bytes, bytes]]]]:
+        from merklekv_tpu.merkle.cpu import build_levels, ref_level_sizes
+
+        peer = report.peer
+        metrics = get_metrics()
+        # Progress baseline: repairs applied BEFORE the walk (resumed
+        # pending sets) don't count as this walk's progress — only a cursor
+        # that advanced past the checkpoint or fresh repairs re-earn
+        # retries (the paged walk's rule); a walk that keeps dying at the
+        # same frontier must accumulate attempts toward abandonment.
+        base_repairs = report.set_keys + report.deleted_keys
+
+        def attempts_now(cursor: bytes) -> int:
+            progressed = (
+                cursor != start
+                or report.set_keys + report.deleted_keys > base_repairs
+            )
+            return 0 if progressed else prior_attempts
+
+        def fail_checkpoint(cursor: bytes, why: str) -> None:
+            # Descent failures are not page-stream faults, so the carried
+            # page size passes through unshrunk.
+            self._checkpoint(peer, [], 0, attempts_now(cursor),
+                             cursor=cursor, page_size=start_page, walk=True)
+            self._degrade(peer, why)
+            metrics.inc("anti_entropy.interrupted_repairs")
+
+        # Capability probe + remote leaf count: a zero-width TREELEVEL. An
+        # old peer answers ERROR (degrade to paging); an empty peer is
+        # cheaper to mirror with the paged scan.
+        try:
+            _, remote_n = client.tree_level(0, 0, 0)
+        except ProtocolError:
+            return False, None  # no TREELEVEL on this peer
+        except (MerkleKVError, OSError) as e:
+            fail_checkpoint(start, f"tree walk probe died: {e!r}")
+            raise
+        if remote_n <= 0:
+            return False, None
+
+        report.mode = "bisect"
+
+        # Local reference tree: one leaf-digest pass over the snapshot
+        # (device-batched when the keyspace is large enough) and one
+        # host-side node reduction. The paged scan pays the same leaf
+        # pass; the node levels are what let this cycle SKIP shipping the
+        # leaf digests of converged subtrees.
+        local_items = self._engine.snapshot()
+        local_keys = [k for k, _ in local_items]
+        local_hashes = _leaf_map(
+            local_items, self._use_device(len(local_items))
+        )
+        local_levels = build_levels([local_hashes[k] for k in local_keys])
+        report.local_keys = len(local_items)
+        precomputed = (local_keys, local_hashes)
+
+        sizes = ref_level_sizes(remote_n)
+        height = len(sizes)
+
+        def local_node(level: int, idx: int) -> Optional[bytes]:
+            if level < len(local_levels) and idx < len(local_levels[level]):
+                return local_levels[level][idx]
+            return None
+
+        # Descend until a subtree spans only _WALK_LEAF_SPAN leaves; the
+        # remaining tail is one small bounded leaf fetch per range.
+        stop_level = 0
+        while (1 << (stop_level + 1)) <= self._WALK_LEAF_SPAN:
+            stop_level += 1
+        stop_level = min(stop_level, height - 1)
+
+        level = height - 1
+        divergent = [0]  # the root differs (HASH compare, or mid-walk resume)
+        while level > stop_level and divergent:
+            child_level = level - 1
+            m_child = sizes[child_level]
+            cand: list[int] = []
+            for idx in divergent:
+                lo = 2 * idx
+                if lo < m_child:
+                    cand.append(lo)
+                if lo + 1 < m_child:
+                    cand.append(lo + 1)
+                # lo + 1 >= m_child: odd-promotion — the parent IS cand[lo].
+            # One TREELEVEL fetch per contiguous index run (a sparse
+            # frontier stays sparse on the wire).
+            runs: list[tuple[int, int]] = []
+            for idx in cand:
+                if runs and runs[-1][1] == idx:
+                    runs[-1] = (runs[-1][0], idx + 1)
+                else:
+                    runs.append((idx, idx + 1))
+            remote_dig: dict[int, bytes] = {}
+            for rlo, rhi in runs:
+                try:
+                    rows, n_now = client.tree_level(child_level, rlo, rhi)
+                except ProtocolError as e:
+                    # Mid-walk protocol garbage = corrupted stream (the
+                    # probe already proved the verb): keep the verified
+                    # cursor and abort the cycle.
+                    fail_checkpoint(start, f"tree walk corrupted: {e!r}")
+                    raise
+                except (MerkleKVError, OSError) as e:
+                    fail_checkpoint(start, f"tree walk died: {e!r}")
+                    raise
+                if n_now != remote_n:
+                    # Keyspace churned mid-descent: node indices no longer
+                    # line up. Degrade to the paged scan, which tolerates
+                    # churn natively (reusing this cycle's local hashes).
+                    report.details.append(
+                        f"{peer}: keyspace churned mid-walk "
+                        f"({remote_n} -> {n_now}); paging instead"
+                    )
+                    report.mode = ""
+                    return False, precomputed
+                for i, hx in rows:
+                    remote_dig[i] = bytes.fromhex(hx)
+            report.rounds += 1
+            metrics.inc("sync.rounds")
+            nxt = []
+            for idx in cand:
+                report.nodes_compared += 1
+                if local_node(child_level, idx) != remote_dig.get(idx):
+                    nxt.append(idx)
+            metrics.inc("sync.nodes_compared", len(cand))
+            divergent = nxt
+            level = child_level
+            if len(divergent) > self._MAX_WALK_FRONTIER:
+                break  # massive divergence: coarse intervals win from here
+
+        if not divergent:
+            # Tree levels agree below the root but HASH differed: racing
+            # writes between the probe and the walk. Nothing provably
+            # divergent — the next cycle re-compares.
+            report.details.append(f"{peer}: walk found no divergent subtree")
+            return True, precomputed
+
+        # Divergent nodes -> merged contiguous leaf intervals [a, b).
+        span_len = 1 << level
+        intervals: list[tuple[int, int]] = []
+        for idx in sorted(divergent):
+            a = idx * span_len
+            b = min((idx + 1) * span_len, remote_n)
+            if intervals and intervals[-1][1] >= a:
+                intervals[-1] = (intervals[-1][0], b)
+            else:
+                intervals.append((a, b))
+
+        # Repair each interval with range-bounded pages. Interval
+        # boundaries come from VERIFIED positions (the invariant above), so
+        # everything outside the fetched ranges is already converged.
+        page_size = start_page
+        for a, b in intervals:
+            after = b"" if a == 0 else local_keys[a - 1]
+            upto: Optional[bytes] = None
+            if b < remote_n and b < len(local_keys):
+                upto = local_keys[b]
+            if start and after < start:
+                after = start  # resume clip: prefix <= cursor is verified
+            if upto is not None and upto <= after:
+                continue  # fully repaired before the interruption
+            # The adaptive page size carries across intervals (and, via the
+            # checkpoint, across cycles): a hostile link shrinks it, clean
+            # pages grow it back — same resilience rule as the paged scan.
+            page_size = self._repair_range(
+                client, report, deadline, after, upto, local_keys,
+                local_hashes, attempts_now, start_page=page_size,
+            )
+            if peer in self._sessions:
+                # Deadline checkpoint inside the range repair.
+                return True, precomputed
+        return True, precomputed
+
+    def _repair_range(
+        self,
+        client: MerkleKVClient,
+        report: SyncReport,
+        deadline: Optional[Deadline],
+        after: bytes,
+        upto: Optional[bytes],
+        local_keys: list[bytes],
+        local_hashes: dict[bytes, bytes],
+        attempts_now: Callable[[bytes], int],
+        start_page: int = 0,
+    ) -> int:
+        """Converge one key range (after, upto) against the peer: bounded
+        HASHPAGE pages, deletions applied engine-side, divergent values
+        fetched in mget batches — the same page discipline (checkpoint
+        shape AND adaptive sizing: halve after a dead stream, double after
+        a clean page) as the full paged walk, scoped to a divergent
+        subtree. Returns the final page size so the caller threads it
+        through the remaining intervals (and checkpoints carry it across
+        cycles)."""
+        import bisect
+
+        peer = report.peer
+        size = min(start_page or self._hash_page, self._hash_page)
+        size = max(size, self._MIN_HASH_PAGE)
+
+        def shrunk() -> int:
+            return max(self._MIN_HASH_PAGE, size // 2)
+
+        cursor = after
+        while True:
+            if deadline is not None and deadline.expired():
+                self._checkpoint(peer, [], 0, attempts_now(cursor),
+                                 cursor=cursor, page_size=size, walk=True)
+                self._degrade(peer, "per-peer cycle deadline expired")
+                report.details.append(
+                    f"{peer}: deadline expired mid-walk; cursor "
+                    f"{cursor!r} checkpointed"
+                )
+                return size
+            bounded = upto is not None and cursor != b""
+            try:
+                rows, done = client.leaf_hashes_page(
+                    size,
+                    cursor.decode("utf-8", "surrogateescape"),
+                    upto=(
+                        upto.decode("utf-8", "surrogateescape")
+                        if bounded
+                        else None
+                    ),
+                )
+            except ProtocolError as e:
+                self._checkpoint(peer, [], 0, attempts_now(cursor),
+                                 cursor=cursor, page_size=shrunk(),
+                                 walk=True)
+                self._degrade(peer, f"walk leaf stream corrupted: {e!r}")
+                get_metrics().inc("anti_entropy.interrupted_repairs")
+                raise
+            except (MerkleKVError, OSError) as e:
+                self._checkpoint(peer, [], 0, attempts_now(cursor),
+                                 cursor=cursor, page_size=shrunk(),
+                                 walk=True)
+                self._degrade(peer, f"walk leaf stream died: {e!r}")
+                get_metrics().inc("anti_entropy.interrupted_repairs")
+                raise
+            if upto is not None and not bounded:
+                # The wire can't carry a bound with an empty cursor: trim
+                # client-side; anything trimmed proves the range ended.
+                kept = []
+                for k, h, ts in rows:
+                    if k.encode("utf-8", "surrogateescape") >= upto:
+                        done = True
+                        break
+                    kept.append((k, h, ts))
+                rows = kept
+
+            page: list[tuple[bytes, Optional[bytes], int]] = [
+                (
+                    k.encode("utf-8", "surrogateescape"),
+                    bytes.fromhex(h) if h is not None else None,
+                    ts,
+                )
+                for k, h, ts in rows
+            ]
+            page_keys = {k for k, _, _ in page}
+            # Covered local range: (cursor, last page key], extended to the
+            # range end once the peer reports the range exhausted.
+            lo = bisect.bisect_right(local_keys, cursor)
+            if done:
+                hi = (
+                    bisect.bisect_left(local_keys, upto)
+                    if upto is not None
+                    else len(local_keys)
+                )
+            else:
+                hi = (
+                    bisect.bisect_right(local_keys, page[-1][0])
+                    if page
+                    else lo
+                )
+
+            to_set: list[tuple[bytes, int]] = []
+            for k, digest, ts in page:
+                if digest is None:
+                    # ts-0 sentinel: state unknown server-side; skip (the
+                    # next cycle repairs it) — same rule as the paged walk.
+                    if ts != 0 and k in local_hashes:
+                        self._repair_delete(k, tomb_ts=ts)
+                        report.deleted_keys += 1
+                        report.divergent += 1
+                    continue
+                report.remote_keys += 1
+                if local_hashes.get(k) != digest:
+                    to_set.append((k, ts))
+            for k in local_keys[lo:hi]:
+                if k not in page_keys:
+                    self._repair_delete(k)
+                    report.deleted_keys += 1
+                    report.divergent += 1
+            report.divergent += len(to_set)
+
+            next_cursor = page[-1][0] if page else cursor
+            try:
+                self._repair_sets_resumable(
+                    client, peer, to_set, report, deadline, lww=False,
+                    cursor=next_cursor, walk=True,
+                )
+            except Exception:
+                # The value-fetch checkpoint can't know the page size;
+                # stamp the shrunk one onto the session it just stored.
+                sess = self._sessions.get(peer)
+                if sess is not None:
+                    sess.page_size = shrunk()
+                raise
+            if peer in self._sessions:
+                # Deadline checkpoint inside the repair loop — not a link
+                # fault, so the page size carries over unshrunk.
+                self._sessions[peer].page_size = size
+                return size
+            cursor = next_cursor
+            size = min(self._hash_page, size * 2)
+            if done:
+                return size
+
+    # -- paged hash walk (small-keyspace pairwise path) -----------------------
     _MIN_HASH_PAGE = 16
     # In-cycle reconnect budget: a hostile link can kill every page stream;
     # the cycle keeps reconnecting and resuming from its checkpoint until
@@ -503,6 +947,7 @@ class SyncManager:
         start: bytes,
         prior_attempts: int = 0,
         start_page: int = 0,
+        precomputed: Optional[tuple[list[bytes], dict[bytes, bytes]]] = None,
     ) -> bool:
         """Cursor-paged pairwise repair: fetch the peer's hash list one
         sorted key-range page at a time (HASHPAGE), repairing each page
@@ -521,9 +966,14 @@ class SyncManager:
         # proves the peer serves HASHPAGE: against an old peer this path
         # bails to the monolithic fallback, which computes its own
         # snapshot/hashes — hashing up front would double that cost every
-        # cycle for the whole upgrade window.
+        # cycle for the whole upgrade window. A degraded bisection walk
+        # hands over the (keys, hashes) it already computed this cycle, so
+        # the fallback never re-hashes the keyspace.
         local_keys: list[bytes] = []
         local_hashes: Optional[dict[bytes, bytes]] = None
+        if precomputed is not None:
+            local_keys, local_hashes = precomputed
+            report.local_keys = len(local_keys)
         report.mode = "hash-paged"
 
         import bisect
@@ -739,6 +1189,7 @@ class SyncManager:
         already_repaired: int = 0,
         prior_attempts: int = 0,
         cursor: bytes = b"",
+        walk: bool = False,
     ) -> None:
         """Fetch+apply ``pairs`` in mget batches; checkpoint on failure.
 
@@ -758,7 +1209,7 @@ class SyncManager:
         for i in range(0, len(pairs), self._mget_batch):
             if deadline is not None and deadline.expired():
                 self._checkpoint(peer, pairs[i:], repaired, attempts_now(),
-                                 cursor=cursor)
+                                 cursor=cursor, walk=walk)
                 self._degrade(peer, "per-peer cycle deadline expired")
                 report.details.append(
                     f"{peer}: deadline expired; {len(pairs) - i} repairs "
@@ -770,7 +1221,7 @@ class SyncManager:
                 values = self._fetch_values(client, [k for k, _ in batch])
             except Exception as e:
                 self._checkpoint(peer, pairs[i:], repaired, attempts_now(),
-                                 cursor=cursor)
+                                 cursor=cursor, walk=walk)
                 self._degrade(peer, f"repair stream died: {e!r}")
                 report.details.append(
                     f"{peer}: interrupted mid-repair ({e!r}); "
@@ -951,6 +1402,7 @@ class SyncManager:
                         c, peer, sess.pending_sets, report, deadline,
                         lww=True, already_repaired=sess.repaired,
                         prior_attempts=sess.attempts, cursor=sess.cursor,
+                        walk=sess.walk,
                     )
                 except Exception as e:
                     drop_peer(c, f"{peer}: resume interrupted ({e!r})")

@@ -260,7 +260,15 @@ def _publish_or_queue(t, topic: str, payload: bytes) -> None:
     failure. An in-flight send that the kernel buffered just before an
     undetected death can still be lost — bounding that window needs
     broker acks, which is the one QoS-1 piece deliberately not taken on
-    (anti-entropy repairs the residue; see the replicator docstring)."""
+    (anti-entropy repairs the residue; see the replicator docstring).
+
+    Deliberate post-heal ordering relaxation: a publish issued while the
+    outbox is still draining goes straight to the wire and can OVERTAKE
+    queued pre-outage events. Receivers apply per-key LWW (ts + digest
+    tiebreak), so the overtaken stale event can never clobber newer state;
+    routing live publishes through the outbox until empty would instead
+    stall the write path behind the whole backlog. Documented in
+    docs/PROTOCOL.md ("Post-heal publish ordering")."""
     if t.link_down:
         _enqueue_outbox(t, topic, payload)
         # Enqueue/heal race: if the heal finished (and drained) between the

@@ -150,6 +150,14 @@ class AntiEntropyConfig:
     # true: each cycle gathers ALL peers' leaf hashes and arbitrates per key
     # in one fused [R, N] diff program; false: pairwise local := peer syncs.
     multi_peer: bool = False
+    # Pairwise transfer strategy when roots differ: "auto" runs the
+    # subtree-bisection walk (TREELEVEL descent, wire bytes ∝
+    # divergence·log n) once the local keyspace reaches bisect_threshold
+    # keys and keeps the paged hash scan below it (fewer round trips on a
+    # small keyspace, and the multi-peer fan-out path always gathers
+    # hashes); "bisect" always walks; "page" always scans.
+    mode: str = "auto"
+    bisect_threshold: int = 8192
 
 
 @dataclass
@@ -254,6 +262,15 @@ class Config:
             cfg.anti_entropy.engine = str(ae["engine"])
         if "multi_peer" in ae:
             cfg.anti_entropy.multi_peer = bool(ae["multi_peer"])
+        if "mode" in ae:
+            cfg.anti_entropy.mode = str(ae["mode"])
+        if "bisect_threshold" in ae:
+            cfg.anti_entropy.bisect_threshold = int(ae["bisect_threshold"])
+        if cfg.anti_entropy.mode not in ("auto", "bisect", "page"):
+            raise ValueError(
+                f"[anti_entropy] mode must be auto|bisect|page, "
+                f"got {cfg.anti_entropy.mode!r}"
+            )
         dev = raw.get("device", {})
         if "sharded_mirror" in dev:
             cfg.device.sharded_mirror = bool(dev["sharded_mirror"])

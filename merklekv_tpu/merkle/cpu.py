@@ -30,6 +30,20 @@ def _sort_key(k: str) -> bytes:
     return k.encode("utf-8")
 
 
+def ref_level_sizes(n: int) -> list[int]:
+    """Reference (odd-promotion) tree level sizes for ``n`` leaves:
+    ``[n, (n+1)//2, ...]`` down to 1; empty for ``n <= 0``. The single
+    source of the size law — the device tree's level serving and the sync
+    walk's index math both import it, so a future tree-shape change cannot
+    desync them."""
+    if n <= 0:
+        return []
+    sizes = [n]
+    while sizes[-1] > 1:
+        sizes.append((sizes[-1] + 1) // 2)
+    return sizes
+
+
 def build_levels(leaf_hashes: list[bytes]) -> list[list[bytes]]:
     """Bottom-up levels from sorted leaf hashes. levels[0] is the leaves;
     levels[-1] is [root]. Odd trailing nodes are promoted (copied up)."""

@@ -46,7 +46,11 @@ from merklekv_tpu.ops.dispatch import (
     hash_node_pairs,
     use_pallas,
 )
-from merklekv_tpu.ops.sha256 import digest_to_bytes, sha256_node_pairs
+from merklekv_tpu.ops.sha256 import (
+    digest_to_bytes,
+    digests_to_bytes,
+    sha256_node_pairs,
+)
 
 __all__ = ["DeviceMerkleState"]
 
@@ -450,3 +454,67 @@ class DeviceMerkleState:
         if i < 0 or self._levels is None:
             return None
         return digest_to_bytes(np.asarray(self._levels[0][i]))
+
+    # ------------------------------------------- reference-level serving
+    @staticmethod
+    def ref_level_sizes(n: int) -> list[int]:
+        """Reference (odd-promotion) tree level sizes for ``n`` leaves
+        (shared size law — see merkle/cpu.py)."""
+        from merklekv_tpu.merkle.cpu import ref_level_sizes
+
+        return ref_level_sizes(n)
+
+    def _promoted_last(self, level: int) -> bytes:
+        """The reference tree's LAST node at ``level``, recovered from the
+        padded levels by the promotion-chain walk (same recurrence as
+        ``_ref_root_fn``, stopped at ``level``): the padded tree hashes
+        zero sentinels into its right spine, so only this one position per
+        level can differ from the reference tree."""
+        n = len(self._keys)
+        last = digest_to_bytes(np.asarray(self._levels[0][n - 1]))
+        m = n
+        for lvl in range(1, level + 1):
+            if m <= 1:
+                break
+            if m % 2 == 0:
+                # Even level size: the reference's next last node combines
+                # position m-2 (identical in the padded tree — only the
+                # LAST position per level can differ) with the carried
+                # correction. Odd sizes promote the tail unchanged.
+                from merklekv_tpu.merkle.encoding import node_hash
+
+                prev = digest_to_bytes(
+                    np.asarray(self._levels[lvl - 1][m - 2])
+                )
+                last = node_hash(prev, last)
+            m = (m + 1) // 2
+        return last
+
+    def level_nodes(self, level: int, lo: int, hi: int) -> tuple[list[tuple[int, bytes]], int]:
+        """Reference-tree digests at ``level`` for indices ``[lo, hi)``
+        (clamped to the level's size), plus the live leaf count — the
+        device-side answer to the TREELEVEL wire verb. One batched device
+        gather serves the whole slice; the only host hashing is the O(level)
+        promotion-chain correction when the slice touches the level's last
+        node. Digests are bit-identical to the reference tree (and hence to
+        the native server's host fallback)."""
+        self._flush()
+        n = len(self._keys)
+        if n == 0 or self._levels is None:
+            return [], 0
+        sizes = self.ref_level_sizes(n)
+        if level >= len(sizes):
+            return [], n
+        m = sizes[level]
+        lo = max(0, min(lo, m))
+        hi = max(lo, min(hi, m))
+        if lo == hi:
+            return [], n
+        # One device gather for the whole slice (the padded level's prefix
+        # matches the reference level everywhere but the last position).
+        block = np.asarray(self._levels[level][lo:hi])
+        digs = digests_to_bytes(block)
+        rows = [(lo + i, d) for i, d in enumerate(digs)]
+        if hi == m and level > 0:
+            rows[-1] = (m - 1, self._promoted_last(level))
+        return rows, n

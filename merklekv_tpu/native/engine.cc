@@ -10,6 +10,7 @@
 #include <algorithm>
 #include <cerrno>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <mutex>
@@ -58,6 +59,15 @@ std::string not_a_number(const std::string& key) {
 
 // ------------------------------------------------------------- MemEngine
 
+MemEngine::MemEngine() : max_tombs_(1 << 16) {
+  // Test hook: shrink the per-shard tombstone cap so eviction (and the
+  // resurrection defense around it) is exercisable without ~1M deletes.
+  if (const char* env = ::getenv("MKV_MAX_TOMBS_PER_SHARD")) {
+    int64_t v;
+    if (parse_i64(env, &v) && v > 0) max_tombs_ = size_t(v);
+  }
+}
+
 MemEngine::Shard& MemEngine::shard_for(const std::string& key) {
   return shards_[std::hash<std::string>{}(key) % kShards];
 }
@@ -82,6 +92,7 @@ bool MemEngine::set_with_ts(const std::string& key, const std::string& value,
   // A present value supersedes any deletion record: without this a key
   // would be advertised live AND tombstoned to peers at once.
   s.tombs.erase(key);
+  bump_version();
   return true;
 }
 
@@ -110,7 +121,7 @@ bool MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
     it->second = ts;
     advanced = true;
   }
-  if (s.tombs.size() > kMaxTombsPerShard) {
+  if (s.tombs.size() > max_tombs_) {
     // Amortized eviction: one scan drops the oldest ~1/8 of the map, so a
     // delete-heavy workload at the cap pays the scan once per ~8k deletes
     // instead of on every delete (the scan holds the shard's write lock).
@@ -120,13 +131,23 @@ bool MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
       (void)k;
       tss.push_back(t);
     }
-    auto cut = tss.begin() + ptrdiff_t(tss.size() / 8);
+    // Cut at ~1/8 of the map (at least 1 — size/8 truncates to zero under
+    // the MKV_MAX_TOMBS_PER_SHARD test hook's small caps) and evict EVERY
+    // record at or below the cutoff timestamp: eviction is then strictly
+    // oldest-first, so the high-water mark below covers exactly what was
+    // dropped and no old tombstone can linger past newer evictees on map
+    // iteration order.
+    const size_t target = std::max<size_t>(1, tss.size() / 8);
+    auto cut = tss.begin() + ptrdiff_t(target);
     std::nth_element(tss.begin(), cut, tss.end());
     const uint64_t cutoff = *cut;
     size_t evicted = 0;
-    const size_t target = tss.size() / 8;
-    for (auto i = s.tombs.begin(); i != s.tombs.end() && evicted < target;) {
+    for (auto i = s.tombs.begin(); i != s.tombs.end();) {
       if (i->second <= cutoff) {
+        // The high-water mark remembers the newest ts this shard ever
+        // evicted: set_if_newer uses it as a conservative floor so an
+        // evicted deletion still blocks stale resurrection.
+        if (i->second > s.tomb_evict_hwm) s.tomb_evict_hwm = i->second;
         i = s.tombs.erase(i);
         ++evicted;
       } else {
@@ -134,7 +155,8 @@ bool MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
       }
     }
     // Every evicted record is a deletion the cluster can no longer defend
-    // against stale resurrection — count them (surfaced via STATS).
+    // against stale resurrection by an unconditional write — count them
+    // (surfaced via STATS; LWW installs stay defended via the HWM).
     tomb_evictions_.fetch_add(evicted, std::memory_order_relaxed);
   }
   return advanced;
@@ -156,13 +178,16 @@ bool MemEngine::del_with_ts_report(const std::string& key, uint64_t ts,
   bool existed = s.map.erase(key) > 0;
   bool tomb_advanced = note_tomb(s, key, ts);
   *advanced = existed || tomb_advanced;
+  if (*advanced) bump_version();
   return existed;
 }
 
 bool MemEngine::del_quiet(const std::string& key) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  return s.map.erase(key) > 0;
+  bool existed = s.map.erase(key) > 0;
+  if (existed) bump_version();
+  return existed;
 }
 
 bool MemEngine::set_if_newer(const std::string& key, const std::string& value,
@@ -186,8 +211,21 @@ bool MemEngine::set_if_newer(const std::string& key, const std::string& value,
   }
   auto tt = s.tombs.find(key);
   if (tt != s.tombs.end() && ts < tt->second) return false;  // tie: value wins
+  if (it == s.map.end() && tt == s.tombs.end() &&
+      ts < s.tomb_evict_hwm) {
+    // ABSENT key, no tombstone on record, but this shard has EVICTED
+    // tombstones as new as tomb_evict_hwm — one of them may have covered
+    // this key. Rejecting installs older than the mark keeps an evicted
+    // deletion deletion-stable (no resurrection by a stale replica); the
+    // write stays repairable through unconditional mirror sync if it was
+    // genuinely disjoint. A LIVE key is exempt: its last set erased any
+    // tombstone, so rejecting a newer-than-entry update would buy no
+    // deletion-stability — it would only pin the stale value.
+    return false;
+  }
   s.map[key] = Entry{value, ts};
   if (tt != s.tombs.end()) s.tombs.erase(tt);
+  bump_version();
   return true;
 }
 
@@ -199,13 +237,16 @@ bool MemEngine::del_if_newer(const std::string& key, uint64_t ts) {
     if (ts <= it->second.ts) return false;  // tie: value wins
     s.map.erase(it);
     note_tomb(s, key, ts);
+    bump_version();
     return true;
   }
   // Absent key: record the tombstone — it blocks older writes from
   // resurrecting later. "Applied" only if it actually advanced (a newer
   // tombstone already on record means local state already covers this
   // deletion, and callers must not log/notify a no-op).
-  return note_tomb(s, key, ts);
+  bool advanced = note_tomb(s, key, ts);
+  if (advanced) bump_version();
+  return advanced;
 }
 
 std::optional<uint64_t> MemEngine::tombstone_ts(const std::string& key) {
@@ -260,8 +301,8 @@ std::vector<std::string> MemEngine::scan(const std::string& prefix) {
   return out;
 }
 
-std::vector<std::pair<std::string, bool>> Engine::page_after(
-    const std::string& after, size_t limit) {
+std::vector<std::pair<std::string, bool>> Engine::page_between(
+    const std::string& after, const std::string* upto, size_t limit) {
   // Generic fallback: merge the two sorted exports. Correct for any
   // engine, but O(N log N) per page — engines with direct access to their
   // storage should override (MemEngine below).
@@ -274,6 +315,10 @@ std::vector<std::pair<std::string, bool>> Engine::page_after(
   while (out.size() < limit && (i < keys.size() || j < tombs.size())) {
     bool take_live =
         i < keys.size() && (j >= tombs.size() || keys[i] <= tombs[j].first);
+    // Exclusive upper bound: the next row in merge order is out of range,
+    // so the whole remaining stream is too — the range is exhausted.
+    const std::string& next_key = take_live ? keys[i] : tombs[j].first;
+    if (upto && next_key >= *upto) break;
     if (take_live) {
       // scan() and tombstones() are two separate reads, so a racing
       // delete can land a key in both; keep the live row (the caller
@@ -290,8 +335,8 @@ std::vector<std::pair<std::string, bool>> Engine::page_after(
   return out;
 }
 
-std::vector<std::pair<std::string, bool>> MemEngine::page_after(
-    const std::string& after, size_t limit) {
+std::vector<std::pair<std::string, bool>> MemEngine::page_between(
+    const std::string& after, const std::string* upto, size_t limit) {
   // Bounded top-k selection: the `limit` smallest keys strictly after the
   // cursor via a max-heap, O(N log limit) per page with no full-keyspace
   // vector or sort — a paged anti-entropy walk over N keys costs
@@ -300,12 +345,16 @@ std::vector<std::pair<std::string, bool>> MemEngine::page_after(
   // tombstone map are disjoint (a set erases its tombstone under the same
   // lock), and both are read under one shared_lock here, so no key can
   // appear twice and the page never comes up short while keys remain.
+  // An exclusive `upto` bound drops out-of-range keys at offer time, so a
+  // range-bounded page (the bisection walk's leaf fetch) never selects —
+  // let alone ships — anything past the divergent range.
   using Row = std::pair<std::string, bool>;  // (key, is_tombstone)
   auto by_key = [](const Row& a, const Row& b) { return a.first < b.first; };
   std::vector<Row> heap;
   heap.reserve(limit + 1);
   auto offer = [&](const std::string& k, bool tomb) {
     if (k <= after) return;
+    if (upto && k >= *upto) return;
     if (heap.size() == limit && heap.front().first <= k) return;
     heap.emplace_back(k, tomb);
     std::push_heap(heap.begin(), heap.end(), by_key);
@@ -359,6 +408,7 @@ Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
   int64_t next = int64_t(uint64_t(cur) + uint64_t(delta));
   s.map[key] = Entry{std::to_string(next), now_ns()};
   s.tombs.erase(key);  // live entry supersedes any deletion record
+  bump_version();
   return Result<int64_t>::Ok(next);
 }
 
@@ -385,6 +435,7 @@ Result<std::string> MemEngine::splice(const std::string& key,
   }
   s.map[key] = Entry{next, now_ns()};
   s.tombs.erase(key);  // live entry supersedes any deletion record
+  bump_version();
   return Result<std::string>::Ok(next);
 }
 
@@ -405,7 +456,9 @@ bool MemEngine::truncate() {
     // TRUNCATE is a local admin wipe, not a per-key deletion: it stays
     // local (never replicated) and drops deletion history with the data.
     s.tombs.clear();
+    s.tomb_evict_hwm = 0;  // the wipe erases deletion knowledge by intent
   }
+  bump_version();
   return true;
 }
 

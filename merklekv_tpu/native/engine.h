@@ -97,8 +97,22 @@ class Engine {
   // must not drop rows mid-page. Base implementation pages over
   // scan()+tombstones(); MemEngine overrides with a bounded top-k
   // selection so a paged walk does not sort the whole keyspace per page.
-  virtual std::vector<std::pair<std::string, bool>> page_after(
-      const std::string& after, size_t limit);
+  std::vector<std::pair<std::string, bool>> page_after(
+      const std::string& after, size_t limit) {
+    return page_between(after, nullptr, limit);
+  }
+  // Range-bounded form: rows strictly after `after` and (when `upto` is
+  // non-null) strictly below `*upto` — the bisection walk's leaf fetch for
+  // ONE divergent key range. Fewer rows than `limit` means the RANGE is
+  // exhausted.
+  virtual std::vector<std::pair<std::string, bool>> page_between(
+      const std::string& after, const std::string* upto, size_t limit);
+  // Monotonic mutation counter: any state change (value or tombstone)
+  // bumps it, so the server's cached TREELEVEL tree knows when it is
+  // stale. The base fallback is ALWAYS-CHANGING (never reuse a cache) so
+  // an engine that doesn't track versions degrades to per-request rebuild
+  // instead of serving stale digests.
+  virtual uint64_t version() { return ++fallback_version_; }
   virtual size_t dbsize() = 0;
   virtual size_t memory_usage() = 0;  // bytes (keys + values)
   // Missing key counts as 0 (reference rwlock_engine.rs:252-320); non-numeric
@@ -119,12 +133,17 @@ class Engine {
   // by a stale replica; this counter makes that silent degradation visible
   // (surfaced via STATS as tombstone_evictions).
   virtual uint64_t tomb_evictions() { return 0; }
+
+ private:
+  std::atomic<uint64_t> fallback_version_{0};
 };
 
 // In-memory engine: 16-way sharded hash map, per-shard reader/writer locks.
 class MemEngine : public Engine {
  public:
   static constexpr size_t kShards = 16;
+
+  MemEngine();
 
   std::optional<std::string> get(const std::string& key) override;
   bool set(const std::string& key, const std::string& value) override;
@@ -151,8 +170,9 @@ class MemEngine : public Engine {
   std::vector<std::pair<std::string, uint64_t>> key_timestamps() override;
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
-  std::vector<std::pair<std::string, bool>> page_after(
-      const std::string& after, size_t limit) override;
+  std::vector<std::pair<std::string, bool>> page_between(
+      const std::string& after, const std::string* upto,
+      size_t limit) override;
   size_t dbsize() override;
   size_t memory_usage() override;
   Result<int64_t> increment(const std::string& key, int64_t amount) override;
@@ -167,6 +187,9 @@ class MemEngine : public Engine {
   uint64_t tomb_evictions() override {
     return tomb_evictions_.load(std::memory_order_relaxed);
   }
+  uint64_t version() override {
+    return version_.load(std::memory_order_acquire);
+  }
 
  private:
   struct Entry {
@@ -176,23 +199,37 @@ class MemEngine : public Engine {
   struct Shard {
     mutable std::shared_mutex mu;
     std::unordered_map<std::string, Entry> map;
-    // key -> deletion ts. Bounded (kMaxTombsPerShard): the oldest tombstone
-    // is evicted on overflow — an evicted tombstone degrades to the
-    // reference's no-tombstone behavior for that key, never worse — and
-    // every eviction is counted (tomb_evictions_).
+    // key -> deletion ts. Bounded (max_tombs_): the oldest tombstones are
+    // evicted on overflow and every eviction is counted (tomb_evictions_).
     std::unordered_map<std::string, uint64_t> tombs;
+    // Evicted-tombstone high-water mark: the newest deletion ts this shard
+    // has ever EVICTED. Closes the resurrection hole the bounded map
+    // opens: set_if_newer rejects any write older than this mark for a key
+    // with no tombstone on record, because an evicted tombstone at up to
+    // this ts may have covered it — a stale replica can no longer
+    // resurrect a deletion just because its record was evicted. The cost
+    // is conservatism: legitimately-old disjoint writes below the mark
+    // also lose LWW repair on this shard (they remain repairable through
+    // pairwise mirror sync, which is unconditional).
+    uint64_t tomb_evict_hwm = 0;
   };
-  static constexpr size_t kMaxTombsPerShard = 1 << 16;
   // Records the deletion; returns whether the tombstone advanced (new, or
   // moved to a later ts). Caller holds the shard's unique lock.
   bool note_tomb(Shard& s, const std::string& key, uint64_t ts);
   Shard& shard_for(const std::string& key);
+  void bump_version() {
+    version_.fetch_add(1, std::memory_order_acq_rel);
+  }
   Result<int64_t> add(const std::string& key, int64_t delta);
   Result<std::string> splice(const std::string& key, const std::string& value,
                              bool append);
 
   Shard shards_[kShards];
+  // Default 1<<16; MKV_MAX_TOMBS_PER_SHARD overrides (tests shrink it to
+  // exercise eviction without a million deletes).
+  size_t max_tombs_;
   std::atomic<uint64_t> tomb_evictions_{0};
+  std::atomic<uint64_t> version_{1};
 };
 
 // Durable engine: MemEngine semantics + append-only operation log
@@ -226,10 +263,12 @@ class LogEngine : public Engine {
   }
   bool exists(const std::string& key) override;
   std::vector<std::string> scan(const std::string& prefix) override;
-  std::vector<std::pair<std::string, bool>> page_after(
-      const std::string& after, size_t limit) override {
-    return mem_.page_after(after, limit);
+  std::vector<std::pair<std::string, bool>> page_between(
+      const std::string& after, const std::string* upto,
+      size_t limit) override {
+    return mem_.page_between(after, upto, limit);
   }
+  uint64_t version() override { return mem_.version(); }
   size_t dbsize() override;
   size_t memory_usage() override;
   Result<int64_t> increment(const std::string& key, int64_t amount) override;

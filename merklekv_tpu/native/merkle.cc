@@ -55,4 +55,28 @@ bool merkle_root(std::vector<std::pair<std::string, std::string>> items,
   return true;
 }
 
+std::vector<std::vector<std::array<uint8_t, 32>>> merkle_levels(
+    const std::vector<std::pair<std::string, std::string>>& items) {
+  std::vector<std::vector<std::array<uint8_t, 32>>> levels;
+  if (items.empty()) return levels;
+  levels.emplace_back(items.size());
+  for (size_t i = 0; i < items.size(); ++i) {
+    leaf_hash(items[i].first, items[i].second, levels[0][i].data());
+  }
+  while (levels.back().size() > 1) {
+    const auto& cur = levels.back();
+    std::vector<std::array<uint8_t, 32>> next((cur.size() + 1) / 2);
+    size_t pairs = cur.size() / 2;
+    for (size_t i = 0; i < pairs; ++i) {
+      uint8_t msg[64];
+      std::memcpy(msg, cur[2 * i].data(), 32);
+      std::memcpy(msg + 32, cur[2 * i + 1].data(), 32);
+      sha256(msg, 64, next[i].data());
+    }
+    if (cur.size() % 2) next[pairs] = cur.back();  // odd-node promotion
+    levels.push_back(std::move(next));
+  }
+  return levels;
+}
+
 }  // namespace mkv

@@ -7,6 +7,7 @@
 // server answers without a device round-trip; bulk rebuild/diff runs on TPU.
 #pragma once
 
+#include <array>
 #include <cstdint>
 #include <string>
 #include <utility>
@@ -23,5 +24,14 @@ void leaf_hash(const std::string& key, const std::string& value,
 // empty tree as 64 zeros.
 bool merkle_root(std::vector<std::pair<std::string, std::string>> items,
                  uint8_t out[32]);
+
+// ALL tree levels bottom-up over an ALREADY-SORTED (key, value) snapshot:
+// levels[0] are the leaf digests, levels.back() is [root]; an odd trailing
+// node is promoted unchanged. Empty input -> empty vector. Backs the
+// TREELEVEL verb's host-side fallback (the server caches the result keyed
+// on the engine's mutation version, so one build amortizes over a whole
+// bisection walk).
+std::vector<std::vector<std::array<uint8_t, 32>>> merkle_levels(
+    const std::vector<std::pair<std::string, std::string>>& items);
 
 }  // namespace mkv

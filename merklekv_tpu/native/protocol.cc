@@ -167,7 +167,7 @@ ParseResult parse_command(const std::string& line) {
     Command c;
     if (u == "GET" || u == "SET" || u == "DELETE" || u == "DEL" ||
         u == "ECHO" || u == "EXISTS" || u == "SYNC" || u == "REPLICATE" ||
-        u == "HASHPAGE") {
+        u == "HASHPAGE" || u == "TREELEVEL") {
       return err(u + " command requires arguments");
     }
     if (u == "TRUNCATE") { c.verb = Verb::Truncate; return ok(std::move(c)); }
@@ -347,12 +347,13 @@ ParseResult parse_command(const std::string& line) {
     return ok(std::move(c));
   }
   if (u == "HASHPAGE") {
-    // "HASHPAGE <count> [<after>]" — the paged form of LEAFHASHES. The
-    // cursor is a key (exclusive); keys cannot contain spaces, so plain
-    // whitespace splitting is unambiguous.
+    // "HASHPAGE <count> [<after> [<upto>]]" — the paged form of LEAFHASHES.
+    // The cursor is a key (exclusive lower bound) and <upto> an exclusive
+    // upper bound; keys cannot contain spaces, so plain whitespace
+    // splitting is unambiguous.
     auto toks = split_ws(rest);
-    if (toks.empty() || toks.size() > 2) {
-      return err("HASHPAGE requires arguments: <count> [<after>]");
+    if (toks.empty() || toks.size() > 3) {
+      return err("HASHPAGE requires arguments: <count> [<after> [<upto>]]");
     }
     int64_t count;
     if (!parse_i64_str(toks[0], &count) || count <= 0) {
@@ -361,10 +362,40 @@ ParseResult parse_command(const std::string& line) {
     Command c;
     c.verb = Verb::HashPage;
     c.amount = count;
-    if (toks.size() == 2) {
+    if (toks.size() >= 2) {
       if (auto e = bad_char(toks[1], "key")) return err(*e);
       c.prefix = toks[1];
     }
+    if (toks.size() == 3) {
+      if (auto e = bad_char(toks[2], "key")) return err(*e);
+      if (toks[2] <= c.prefix) {
+        return err("HASHPAGE upto must be greater than after");
+      }
+      c.upto = toks[2];
+    }
+    return ok(std::move(c));
+  }
+  if (u == "TREELEVEL") {
+    // "TREELEVEL <level> <lo> <hi>" — interior digests [lo, hi) of the
+    // reference tree at `level` (0 = leaves). lo == hi is a valid empty
+    // probe (capability check + leaf-count fetch).
+    auto toks = split_ws(rest);
+    if (toks.size() != 3) {
+      return err("TREELEVEL requires arguments: <level> <lo> <hi>");
+    }
+    int64_t level, lo, hi;
+    if (!parse_i64_str(toks[0], &level) || level < 0) {
+      return err("TREELEVEL level must be a non-negative integer");
+    }
+    if (!parse_i64_str(toks[1], &lo) || !parse_i64_str(toks[2], &hi) ||
+        lo < 0 || hi < lo) {
+      return err("TREELEVEL range must satisfy 0 <= lo <= hi");
+    }
+    Command c;
+    c.verb = Verb::TreeLevel;
+    c.level = level;
+    c.lo = lo;
+    c.hi = hi;
     return ok(std::move(c));
   }
   if (u == "INC") return parse_numeric(Verb::Increment, "INC", rest);

@@ -21,10 +21,18 @@ enum class Verb {
   MultiGet, MultiSet, Truncate, Exists, Scan, Dbsize, Hash,
   LeafHashes, Stats, Info, Version, Memory, ClientList, Flushdb, Shutdown,
   Ping, Echo, Sync, Replicate,
-  // Cursor-paged LEAFHASHES: "HASHPAGE <count> [<after>]" emits up to
-  // <count> merged (live + tombstone) hash lines for keys strictly after
-  // the cursor, in sorted order — the unit of resumable anti-entropy.
+  // Cursor-paged LEAFHASHES: "HASHPAGE <count> [<after> [<upto>]]" emits up
+  // to <count> merged (live + tombstone) hash lines for keys strictly after
+  // the cursor, in sorted order — the unit of resumable anti-entropy. The
+  // optional exclusive upper bound <upto> makes the page range-bounded: the
+  // bisection walk fetches leaf hashes for ONE divergent key range without
+  // the server selecting (or shipping) anything past the boundary.
   HashPage,
+  // Subtree-bisection anti-entropy: "TREELEVEL <level> <lo> <hi>" serves
+  // interior digests [lo, hi) of the reference (odd-promotion) Merkle tree
+  // at `level` (0 = leaves), plus the live leaf count — so a peer can walk
+  // the tree top-down and descend only into divergent subtrees.
+  TreeLevel,
   // Extension (like LEAFHASHES): per-peer health table from the cluster
   // control plane's failure detector.
   Peers,
@@ -42,6 +50,8 @@ struct Command {
   std::vector<std::pair<std::string, std::string>> pairs;  // MultiSet
   std::string message;             // Ping/Echo
   std::string prefix;              // Scan / LeafHashes; HashPage after-cursor
+  std::optional<std::string> upto;     // HashPage exclusive upper bound
+  int64_t level = 0, lo = 0, hi = 0;   // TreeLevel
   std::optional<std::string> pattern;  // Hash
   std::string host;                // Sync
   uint16_t port = 0;               // Sync

@@ -6,6 +6,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -70,7 +71,27 @@ bool Server::start() {
   bound_port_ = ntohs(bound.sin_port);
   listen_fd_.store(fd, std::memory_order_release);
   accept_thread_ = std::thread([this] { accept_loop(); });
+  tree_reaper_ = std::thread([this] { tree_reaper_loop(); });
   return true;
+}
+
+void Server::tree_reaper_loop() {
+  // Free the TREELEVEL host cache after it sits idle: a bisection walk
+  // uses it for seconds, the anti-entropy period is minutes, and the
+  // levels cost ~64 bytes per key.
+  constexpr auto kIdle = std::chrono::seconds(30);
+  while (!stop_.load(std::memory_order_acquire)) {
+    // Short poll: ~free when idle, and server shutdown (stop -> wait
+    // joins this thread) never stalls behind a long sleep.
+    ::usleep(50 * 1000);
+    std::lock_guard lk(tree_mu_);
+    if (tree_valid_ &&
+        std::chrono::steady_clock::now() - tree_last_used_ > kIdle) {
+      tree_levels_.clear();
+      tree_levels_.shrink_to_fit();
+      tree_valid_ = false;
+    }
+  }
 }
 
 void Server::stop() {
@@ -91,6 +112,7 @@ void Server::stop() {
 
 void Server::wait() {
   if (accept_thread_.joinable()) accept_thread_.join();
+  if (tree_reaper_.joinable()) tree_reaper_.join();
   {
     std::lock_guard lk(lifecycle_mu_);
     int fd = listen_fd_.exchange(-1, std::memory_order_acq_rel);
@@ -454,11 +476,14 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       // Fewer lines than requested means the keyspace is exhausted.
       const std::string& after = cmd.prefix;
       const int64_t want = cmd.amount.value_or(1);
-      // page_after is the engine's bounded top-k selection: O(N log page)
+      // page_between is the engine's bounded top-k selection: O(N log page)
       // per request instead of materializing + sorting the whole keyspace
       // for every page of the walk (which made one full paged walk
-      // O(N^2/page) — ruinous at the 10M-key target).
-      auto rows = engine_->page_after(after, size_t(want));
+      // O(N^2/page) — ruinous at the 10M-key target). The optional
+      // exclusive upper bound serves the bisection walk's range-bounded
+      // leaf fetch: nothing past the divergent range is selected or sent.
+      const std::string* upto = cmd.upto ? &*cmd.upto : nullptr;
+      auto rows = engine_->page_between(after, upto, size_t(want));
       std::string body;
       int64_t listed = 0;
       for (auto& [k, was_tomb] : rows) {
@@ -490,6 +515,66 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         }
       }
       return "HASHES " + std::to_string(listed) + "\r\n" + body;
+    }
+    case Verb::TreeLevel: {
+      // Subtree-bisection anti-entropy: digests [lo, hi) of reference-tree
+      // level `level` (0 = leaves), plus the live leaf count, so a peer's
+      // walk can descend only into divergent subtrees. The cluster control
+      // plane gets first refusal — it serves straight from the
+      // device-resident incremental tree; without one the host fallback
+      // below builds the levels once and reuses them until the engine
+      // mutates (version-keyed cache), so one O(n) build amortizes over a
+      // whole walk (~log n requests).
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp = cb("TREELEVEL " + std::to_string(cmd.level) +
+                              " " + std::to_string(cmd.lo) + " " +
+                              std::to_string(cmd.hi));
+        if (!resp.empty()) return resp;
+      }
+      std::lock_guard lk(tree_mu_);
+      // Version read BEFORE the snapshot: a write landing in between makes
+      // the cache look older than it is, which only costs one extra
+      // rebuild — never an unbounded-stale answer.
+      //
+      // Short serve-stale TTL on top of the version check: under a live
+      // write load EVERY request would otherwise miss (each write bumps
+      // the version) and pay a full O(n) snapshot+hash rebuild while
+      // holding tree_mu_. Serving one CONSISTENT tree for the TTL is also
+      // what a mid-walk peer needs — per-request rebuilds would shift the
+      // leaf count between its fetches and abort the walk as churn. The
+      // walk tolerates the bounded staleness by design (next cycle's root
+      // compare re-verifies).
+      constexpr auto kServeStale = std::chrono::seconds(5);
+      const auto now = std::chrono::steady_clock::now();
+      uint64_t v = engine_->version();
+      if (!tree_valid_ ||
+          (v != tree_version_ && now - tree_built_ > kServeStale)) {
+        tree_levels_ = merkle_levels(engine_->snapshot());
+        tree_version_ = v;
+        tree_valid_ = true;
+        tree_built_ = now;
+      }
+      tree_last_used_ = now;
+      size_t n = tree_levels_.empty() ? 0 : tree_levels_[0].size();
+      std::string body;
+      size_t count = 0;
+      if (size_t(cmd.level) < tree_levels_.size()) {
+        const auto& lvl = tree_levels_[size_t(cmd.level)];
+        size_t lo = std::min(size_t(cmd.lo), lvl.size());
+        size_t hi = std::min(size_t(cmd.hi), lvl.size());
+        for (size_t i = lo; i < hi; ++i) {
+          body += std::to_string(i) + " " + digest_hex(lvl[i].data()) +
+                  "\r\n";
+          ++count;
+        }
+      }
+      return "NODES " + std::to_string(count) + " " + std::to_string(n) +
+             "\r\n" + body;
     }
     case Verb::Truncate:
     case Verb::Flushdb: {

@@ -13,7 +13,9 @@
 //     server.rs:909-923) or just stops the server (embedded mode).
 #pragma once
 
+#include <array>
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <functional>
 #include <map>
@@ -21,6 +23,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "engine.h"
 #include "events.h"
@@ -109,6 +112,23 @@ class Server {
 
   std::mutex cb_mu_;
   ClusterCallback cluster_cb_;
+
+  // TREELEVEL host fallback: reference-tree levels built from an engine
+  // snapshot, cached keyed on the engine's mutation version so one O(n)
+  // build amortizes over a whole bisection walk (~log n requests). The
+  // cluster callback (device-resident tree) gets first refusal; this cache
+  // only serves when no control plane answers. The levels sum to ~64 B per
+  // key and a walk needs them for seconds per anti-entropy period, so a
+  // reaper thread frees the cache once it sits idle (tree_last_used_)
+  // instead of pinning ~640 MB at the 10M-key target forever.
+  void tree_reaper_loop();
+  std::mutex tree_mu_;
+  bool tree_valid_ = false;
+  uint64_t tree_version_ = 0;
+  std::chrono::steady_clock::time_point tree_last_used_{};
+  std::chrono::steady_clock::time_point tree_built_{};
+  std::vector<std::vector<std::array<uint8_t, 32>>> tree_levels_;
+  std::thread tree_reaper_;
 };
 
 }  // namespace mkv

@@ -92,7 +92,8 @@ struct ServerStats {
       case Verb::Sync: sync_commands++; break;
       case Verb::Hash:
       case Verb::LeafHashes:
-      case Verb::HashPage: hash_commands++; break;
+      case Verb::HashPage:
+      case Verb::TreeLevel: hash_commands++; break;
       case Verb::Replicate: replicate_commands++; break;
     }
   }
