@@ -339,6 +339,58 @@ def bench_sync_wire_bytes(n_keys: int) -> dict:
         eng_b.close()
 
 
+def bench_metrics_overhead(n_ops: int, rounds: int = 5) -> dict:
+    """Metrics-plane cost on the SET hot path.
+
+    The only per-command observability cost is the native command-latency
+    histogram (two steady_clock reads + one relaxed atomic add inside the
+    handler); everything else in the metrics plane is off the request path
+    (gauges read at scrape time, spans wrap control-plane work). A/B it
+    with the histogram toggle over INTERLEAVED batches (on/off/on/off, so
+    clock drift and allocator warmup cancel) and compare medians — the
+    acceptance bar is < 5% overhead."""
+    import statistics as stats
+
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    try:
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            def batch(tag: int) -> float:
+                t0 = time.perf_counter()
+                for i in range(n_ops):
+                    c.set(f"ovh:{tag}:{i:07d}", "v")
+                return time.perf_counter() - t0
+
+            batch(-1)  # warm the connection + allocator
+            on_s, off_s = [], []
+            for r in range(rounds):
+                srv.enable_latency(True)
+                on_s.append(batch(2 * r))
+                srv.enable_latency(False)
+                off_s.append(batch(2 * r + 1))
+            srv.enable_latency(True)  # leave the default on
+        on_med, off_med = stats.median(on_s), stats.median(off_s)
+        overhead_pct = (on_med / off_med - 1.0) * 100.0
+        return {
+            "metric": "set_metrics_overhead_pct",
+            "value": round(overhead_pct, 2),
+            "unit": "% (median, histogram on vs off)",
+            "ops_per_batch": n_ops,
+            "rounds": rounds,
+            "on_med_s": round(on_med, 5),
+            "off_med_s": round(off_med, 5),
+            "target": 5.0,
+            "target_met": overhead_pct < 5.0,
+        }
+    finally:
+        srv.close()
+        eng.close()
+
+
 def bench_op_latency(n_ops: int) -> dict:
     """Client-observed op latency: SET/GET p50/p99 over localhost TCP
     against the embedded native server (the reference's test_benchmark.py
@@ -424,6 +476,17 @@ def bench_diff64(n: int, reps: int) -> dict:
     }
 
 
+def _metrics_blob() -> dict:
+    """Counters + span aggregates at this instant (cumulative within the
+    run) — embedded in every emitted JSON record. Histogram buckets are
+    dropped to keep the records compact; the per-span p50/p99 live behind
+    the METRICS verb and /metrics endpoint at serving time."""
+    from merklekv_tpu.utils.tracing import get_metrics
+
+    snap = get_metrics().snapshot()
+    return {"counters": snap["counters"], "spans": snap["spans"]}
+
+
 def main() -> None:
     """Driver entry: ALWAYS leaves one parsable JSON record on stdout and
     exits 0, even when no TPU backend (or no working jax at all) is
@@ -502,13 +565,24 @@ def _run(backend: str) -> None:
         print(f"# op_latency bench failed: {e!r}", file=sys.stderr)
     try:
         configs.append(
+            bench_metrics_overhead(n_ops=5_000 if on_tpu else 1_000)
+        )
+    except Exception as e:
+        print(f"# metrics_overhead bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(
             bench_sync_wire_bytes(n_keys=(1 << 20) if on_tpu else (1 << 14))
         )
     except Exception as e:
         print(f"# sync_wire_bytes bench failed: {e!r}", file=sys.stderr)
 
+    # Every emitted record carries the run's metrics snapshot (counters +
+    # span aggregates) so a BENCH_*.json trajectory shows what the run
+    # actually DID — sync cycles walked, repairs applied, device batches,
+    # fallbacks taken — not just the headline number.
     for cfg in configs:
         cfg["backend"] = backend
+        cfg["metrics"] = _metrics_blob()
         print(json.dumps(cfg), file=sys.stderr)
 
     target_met = seconds < 1.0
@@ -524,6 +598,7 @@ def _run(backend: str) -> None:
                 "target_s": 1.0,
                 "target_met": target_met,
                 "backend": backend,
+                "metrics": _metrics_blob(),
             }
         )
     )

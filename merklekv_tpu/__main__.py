@@ -24,6 +24,12 @@ def main(argv: list[str] | None = None) -> int:
         from merklekv_tpu.storage.walcheck import main as walcheck_main
 
         return walcheck_main(argv[1:])
+    if argv and argv[0] == "top":
+        # Live cluster dashboard: polls STATS/METRICS/PEERS over a node
+        # list and renders rates (docs/OBSERVABILITY.md "top").
+        from merklekv_tpu.obs.top import main as top_main
+
+        return top_main(argv[1:])
 
     p = argparse.ArgumentParser(prog="merklekv_tpu")
     p.add_argument("--config", help="TOML config file")
@@ -35,6 +41,12 @@ def main(argv: list[str] | None = None) -> int:
         "--durable",
         action="store_true",
         help="enable the [storage] WAL+snapshot subsystem",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        help="serve Prometheus /metrics (+/healthz) on this HTTP port "
+             "(-1: ephemeral; overrides [observability] http_port)",
     )
     args = p.parse_args(argv)
 
@@ -64,6 +76,12 @@ def main(argv: list[str] | None = None) -> int:
         cfg.port = args.port
     if args.durable:
         cfg.storage.enabled = True
+    if args.metrics_port is not None:
+        if args.metrics_port < -1:
+            # Same rule the [observability] config-file path enforces.
+            p.error(f"--metrics-port must be -1 (ephemeral), 0 (disabled), "
+                    f"or a TCP port, got {args.metrics_port}")
+        cfg.observability.http_port = args.metrics_port
 
     engine = NativeEngine(cfg.engine, cfg.storage_path)
 
@@ -123,6 +141,11 @@ def main(argv: list[str] | None = None) -> int:
     if storage is not None:
         # After the readiness line — spawning harnesses parse line 1 only.
         print(f"storage: recovered {recovery.summary()}", flush=True)
+    if node.metrics_port is not None:
+        # After the readiness line, same rule; CI's exporter smoke job and
+        # ops harnesses parse this to find an ephemeral metrics port.
+        print(f"metrics: http://{cfg.observability.http_host}:"
+              f"{node.metrics_port}/metrics", flush=True)
 
     stop = {"flag": False}
 

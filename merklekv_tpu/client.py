@@ -452,6 +452,16 @@ class MerkleKVClient:
             raise ProtocolError(f"unexpected response: {resp}")
         return self._read_field_table()
 
+    def trace(self, n: int = 8) -> list[dict[str, str]]:
+        """Correlated anti-entropy traces (TRACE extension verb): the
+        newest ``n`` sync cycles, one dict per (cycle, peer) row with
+        cycle/kind/peer/mode/outcome/bytes/rounds/repairs fields. Empty on
+        a node without a cluster plane."""
+        resp = _parse_simple(self._request(f"TRACE {n}"))
+        if not resp.startswith("TRACES "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_field_table()
+
     def flushdb(self) -> bool:
         return _parse_simple(self._request("FLUSHDB")) == "OK"
 
@@ -653,6 +663,55 @@ class AsyncMerkleKVClient:
     async def ping(self, message: str = "") -> str:
         cmd = f"PING {message}" if message else "PING"
         return _parse_simple(await self._request(cmd))
+
+    async def stats(self) -> dict[str, str]:
+        resp = _parse_simple(await self._request("STATS"))
+        if resp != "STATS":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return await self._read_kv_block()
+
+    async def metrics(self) -> dict[str, str]:
+        """Control-plane counter snapshot — same wire shape and parsing
+        rules as the sync client's ``metrics()`` (METRICS/STATS parity is
+        covered by the test suite)."""
+        resp = _parse_simple(await self._request("METRICS"))
+        if resp != "METRICS":
+            raise ProtocolError(f"unexpected response: {resp}")
+        return await self._read_kv_block()
+
+    async def _read_kv_block(self) -> dict[str, str]:
+        # Same END-or-sentinel protocol as the sync client: pipeline a PING
+        # sentinel so terminator-less servers (reference parity mode) still
+        # delimit the block.
+        payload = b"PING __end__\r\n"
+        self._writer.write(payload)
+        self.bytes_sent += len(payload)
+        await self._writer.drain()
+        out: dict[str, str] = {}
+        while True:
+            line = await self._read_line()
+            if line == "END":
+                while (await self._read_line()) != "PONG __end__":
+                    pass  # drain to the sentinel reply
+                return out
+            if line == "PONG __end__":
+                return out  # terminator-less server
+            name, _, value = line.partition(":")
+            out[name] = value
+
+    async def trace(self, n: int = 8) -> list[dict[str, str]]:
+        """Async TRACE — same semantics as the sync client's ``trace``."""
+        resp = _parse_simple(await self._request(f"TRACE {n}"))
+        if not resp.startswith("TRACES "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        rows = []
+        while True:
+            line = await self._read_line()
+            if line == "END":
+                return rows
+            rows.append(
+                dict(f.split("=", 1) for f in line.split(" ") if "=" in f)
+            )
 
     async def health_check(self) -> bool:
         try:

@@ -41,6 +41,11 @@ class DeviceTreeMirror:
         # current values when the built state is swapped in.
         self._pending: Optional[set] = None
         self._pending_truncate = False
+        # Engine mutation version observed at the last applied batch — the
+        # staleness gauge's anchor ("versions behind live"). Approximate by
+        # design: a write racing the post-apply read is counted as synced
+        # one batch early, never unboundedly.
+        self._synced_version = 0
 
     # -- warm-up -------------------------------------------------------------
     def ready(self) -> bool:
@@ -104,6 +109,7 @@ class DeviceTreeMirror:
                                 [(k, self._engine.get(k)) for k in pend]
                             )
                         self._state = st
+                        self._synced_version = self._engine.version()
                         return
             except Exception:
                 pass
@@ -147,6 +153,7 @@ class DeviceTreeMirror:
                 self._state.apply(
                     [(k, self._engine.get(k)) for k in touched]
                 )
+            self._synced_version = self._engine.version()
 
     def apply_one(self, key: bytes, value: Optional[bytes]) -> None:
         """Remote writes, applied inline by the LWW applier."""
@@ -157,6 +164,7 @@ class DeviceTreeMirror:
                 self._note_pending([key])
                 return
             self._state.apply([(key, value)])
+            self._synced_version = self._engine.version()
 
     def _note_pending(self, keys) -> None:
         """Record writes landing during a warm build (lock held by caller).
@@ -187,6 +195,24 @@ class DeviceTreeMirror:
             if self._closed or self._state is None:
                 return None
             return self._state.level_nodes(level, lo, hi)
+
+    def leaf_count(self) -> int:
+        """Leaf count of the built device tree, or -1 while warming. Reads
+        the sorted key array only — no device work, safe on a gauge path
+        (staged pending changes are not counted until their flush)."""
+        with self._mu:
+            if self._closed or self._state is None:
+                return -1
+            return self._state.leaf_count()
+
+    def staleness(self) -> int:
+        """Engine mutation versions the mirror trails the live keyspace by
+        (0 = fully caught up; -1 while warming). Only meaningful on
+        version-tracking engines (the sharded/log natives)."""
+        with self._mu:
+            if self._closed or self._state is None:
+                return -1  # also guards the engine FFI after close()
+            return max(0, self._engine.version() - self._synced_version)
 
     @property
     def state(self):

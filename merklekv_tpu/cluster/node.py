@@ -40,6 +40,8 @@ class ClusterNode:
         self._mirror = None  # DeviceTreeMirror, alive while replication is on
         self._health = None  # PeerHealthMonitor, alive with the sync loop
         self._rep_mu = threading.Lock()
+        self._exporter = None  # MetricsExporter, alive while the node runs
+        self._gauge_names: list = []  # (name, fn) pairs we registered
         self.sync_manager = SyncManager(
             engine,
             device=cfg.anti_entropy.engine,
@@ -52,6 +54,29 @@ class ClusterNode:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._server.set_cluster_handler(self._on_cluster_command)
+        self._register_gauges()
+        from merklekv_tpu.obs.trace import get_trace_buffer
+
+        get_trace_buffer().set_capacity(self._cfg.observability.trace_cycles)
+        if self._cfg.observability.http_port != 0:
+            # Per-node Prometheus endpoint (/metrics + /healthz): registry
+            # counters/histograms/gauges and the native STATS block in one
+            # namespace. -1 binds an ephemeral port (tests read
+            # metrics_port); failure to bind is reported, never fatal —
+            # the data plane must not die for observability.
+            from merklekv_tpu.obs.exporter import MetricsExporter
+
+            port = self._cfg.observability.http_port
+            try:
+                self._exporter = MetricsExporter(
+                    max(0, port),
+                    host=self._cfg.observability.http_host,
+                    stats_fn=self._server.stats_text,
+                    health_fn=self._health_payload,
+                ).start()
+            except OSError as e:
+                print(f"metrics exporter not started: {e}", file=sys.stderr,
+                      flush=True)
         if self._storage is not None:
             # WAL recording: the store drains the native change-event queue
             # itself until a Replicator takes over the drain (then the
@@ -83,6 +108,10 @@ class ClusterNode:
             )
 
     def stop(self) -> None:
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
+        self._unregister_gauges()
         self.sync_manager.stop()
         if self._health is not None:
             self._health.stop()
@@ -247,6 +276,106 @@ class ClusterNode:
     def health(self):
         return self._health
 
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """Bound port of the /metrics exporter, or None when disabled."""
+        return self._exporter.port if self._exporter is not None else None
+
+    def _health_payload(self) -> dict:
+        """/healthz extra fields: engine reachability + peer summary."""
+        if not self._engine._h:
+            return {"keys": -1}
+        payload = {"keys": self._engine.dbsize(), "port": self._server.port}
+        h = self._health
+        if h is not None:
+            rows = h.snapshot()
+            payload["peers_up"] = sum(1 for r in rows if r.status == "up")
+            payload["peers_total"] = len(rows)
+        return payload
+
+    # -- gauges ---------------------------------------------------------------
+    def _register_gauges(self) -> None:
+        """Callback gauges over this node's live state. Registration
+        replaces same-named gauges (last node wins in multi-node-per-
+        process tests); each is read at scrape time, and a callback that
+        throws drops only its own sample."""
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        m = get_metrics()
+        engine = self._engine
+
+        def live_keys() -> int:
+            # Guard the raw handle: a gauge outliving the engine (node not
+            # stopped before engine.close()) must drop its sample, not
+            # drive the FFI through a dead pointer.
+            return engine.dbsize() if engine._h else -1
+
+        def tombstones() -> int:
+            return len(engine.tombstones()) if engine._h else -1
+
+        def mirror_leaves() -> int:
+            with self._rep_mu:
+                mirror = self._mirror
+            return mirror.leaf_count() if mirror is not None else -1
+
+        def mirror_staleness() -> int:
+            with self._rep_mu:
+                mirror = self._mirror
+            return mirror.staleness() if mirror is not None else -1
+
+        def outbox_depth() -> int:
+            t = self._transport
+            return getattr(t, "outbox_depth", 0) if t is not None else 0
+
+        def peer_states() -> dict:
+            h = self._health
+            if h is None:
+                return {}
+            code = {"up": 2, "degraded": 1, "down": 0, "unknown": -1}
+            return {
+                r.peer: code.get(r.status, -1) for r in h.snapshot()
+            }
+
+        gauges = [
+            ("keyspace.keys", live_keys,
+             "Live keys in the native engine.", ""),
+            ("keyspace.tombstones", tombstones,
+             "Deletion records retained for cluster LWW.", ""),
+            ("device.tree_leaves", mirror_leaves,
+             "Leaf count of the device-resident Merkle tree "
+             "(-1: no mirror).", ""),
+            ("device.mirror_staleness", mirror_staleness,
+             "Engine mutation versions the device mirror trails the live "
+             "keyspace by (-1: no mirror).", ""),
+            ("replication.outbox_depth", outbox_depth,
+             "Events queued in the transport outbox awaiting a broker "
+             "heal.", ""),
+            ("peer.state", peer_states,
+             "Peer health (2=up 1=degraded 0=down -1=unknown).", "peer"),
+        ]
+        if self._storage is not None:
+            storage = self._storage
+            gauges += [
+                ("storage.wal_bytes", storage.wal_size_bytes,
+                 "Total bytes across live WAL segments.", ""),
+                ("storage.wal_segments", storage.wal_segment_count,
+                 "Live WAL segment files.", ""),
+            ]
+        for name, fn, help_, label in gauges:
+            m.register_gauge(name, fn, help=help_, label=label)
+        self._gauge_names = [(g[0], g[1]) for g in gauges]
+
+    def _unregister_gauges(self) -> None:
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        m = get_metrics()
+        for name, fn in self._gauge_names:
+            # Identity-checked: if a later node replaced this name (the
+            # documented last-wins rule), its registration survives our
+            # stop instead of being stripped with ours.
+            m.unregister_gauge(name, fn)
+        self._gauge_names = []
+
     def _metrics_wire(self) -> str:
         """METRICS wire payload: the control plane's counter snapshot —
         transport reconnects/outbox drops, anti-entropy loop counters, span
@@ -254,16 +383,28 @@ class ClusterNode:
         covers the native engine/server scope only."""
         from merklekv_tpu.utils.tracing import get_metrics
 
+        metrics = get_metrics()
         lines = []
-        snap = get_metrics().snapshot()
+        snap = metrics.snapshot()
         for name in sorted(snap["counters"]):
             lines.append(f"{name}:{snap['counters'][name]}")
         # Span aggregates (integers only — the parsers treat values as
-        # numeric text): count and total milliseconds per span name.
+        # numeric text): count, total, and bucket-derived percentiles per
+        # span name. total_us is the canonical total; total_ms is kept one
+        # release for old readers and DEPRECATED (sub-millisecond spans
+        # truncate to 0 there — docs/PROTOCOL.md "METRICS").
         for name in sorted(snap["spans"]):
             sp = snap["spans"][name]
             lines.append(f"span.{name}.count:{sp['count']}")
+            lines.append(f"span.{name}.total_us:{int(sp['total_s'] * 1e6)}")
             lines.append(f"span.{name}.total_ms:{int(sp['total_s'] * 1e3)}")
+            hist = snap["histograms"].get(f"span.{name}")
+            if hist and hist["count"]:
+                h = metrics.histogram(f"span.{name}")
+                for q, tag in ((0.5, "p50_us"), (0.99, "p99_us")):
+                    v = h.quantile(q)
+                    if v is not None:
+                        lines.append(f"span.{name}.{tag}:{int(v * 1e6)}")
         t = self._transport
         if t is not None:
             for attr in ("reconnects", "outbox_dropped", "callback_errors"):
@@ -282,6 +423,13 @@ class ClusterNode:
             return self._health.wire_table()
         if parts[0] == "METRICS":
             return self._metrics_wire()
+        if parts[0] == "TRACE":
+            # Correlated anti-entropy traces: newest n cycles, one k=v row
+            # per (cycle, peer) from the process-wide ring buffer.
+            from merklekv_tpu.obs.trace import get_trace_buffer
+
+            n = int(parts[1]) if len(parts) > 1 else 8
+            return get_trace_buffer().wire_dump(n)
         if parts[0] == "HASH":
             # Whole-keyspace root served from the device-resident
             # incremental tree; empty answer falls back to the native path.

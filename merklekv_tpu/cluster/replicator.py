@@ -34,6 +34,7 @@ from merklekv_tpu.cluster.change_event import (
 )
 from merklekv_tpu.cluster.retry import REPLICATOR_PUBLISH, RetryPolicy
 from merklekv_tpu.cluster.transport import Transport
+from merklekv_tpu.utils.tracing import get_metrics
 from merklekv_tpu.native_bindings import (
     OP_APPEND,
     OP_DECR,
@@ -206,7 +207,13 @@ class Replicator:
                 except Exception:
                     # QoS-0 fabric: drop and count; anti-entropy repairs.
                     self.publish_errors += 1
+                    get_metrics().inc("replicator.publish_errors")
             self.published += published
+            if published:
+                # Registry mirror of the instance counters so METRICS (and
+                # the /metrics endpoint) can see replication flow without a
+                # handle on this object.
+                get_metrics().inc("replicator.published", published)
             if self._batch_listener is not None:
                 try:
                     self._batch_listener(events)
@@ -236,10 +243,12 @@ class Replicator:
             # Malformed messages are tolerated, like the reference's decoder
             # fallthrough (replication.rs:150-157).
             self.decode_errors += 1
+            get_metrics().inc("replicator.decode_errors")
             return
         if ev.src == self.node_id:
             return  # loop prevention
         self.received += 1
+        get_metrics().inc("replicator.received")
         with self._applier_mu:
             self._applier.apply(ev)
 

@@ -42,6 +42,13 @@ from merklekv_tpu.client import MerkleKVClient, MerkleKVError, ProtocolError
 from merklekv_tpu.cluster.retry import SYNC_PEER, Deadline, RetryPolicy
 from merklekv_tpu.merkle.encoding import leaf_hash
 from merklekv_tpu.native_bindings import NativeEngine
+from merklekv_tpu.obs.trace import (
+    CycleTrace,
+    PeerTrace,
+    cycle_scope,
+    get_trace_buffer,
+    next_cycle_id,
+)
 from merklekv_tpu.utils import jaxenv
 from merklekv_tpu.utils.tracing import get_metrics, span
 
@@ -307,16 +314,44 @@ class SyncManager:
     def sync_once(
         self, host: str, port: int, full: bool = False, verify: bool = False
     ) -> SyncReport:
-        with span("anti_entropy.sync_once", peer=f"{host}:{port}") as rec:
-            report = self._sync_once(host, port, full, verify)
-            rec["divergent"] = report.divergent
-            get_metrics().inc("anti_entropy.syncs")
-            get_metrics().inc("anti_entropy.keys_repaired",
-                              report.set_keys + report.deleted_keys)
-            return report
+        # Correlated trace: one cycle id for the whole pairwise cycle —
+        # every span emitted inside (walk, repairs, journaling) is stamped
+        # with it, and the cycle's per-peer outcome lands in the TRACE ring
+        # buffer whether the cycle succeeds, degrades, or raises.
+        peer = f"{host}:{port}"
+        trace = PeerTrace(peer=peer)
+        started, t0 = time.time(), time.perf_counter()
+        cid = next_cycle_id()
+        try:
+            with cycle_scope(cid), \
+                    span("anti_entropy.sync_once", peer=peer) as rec:
+                report = self._sync_once(host, port, full, verify,
+                                         trace=trace)
+                rec["divergent"] = report.divergent
+                get_metrics().inc("anti_entropy.syncs")
+                get_metrics().inc("anti_entropy.keys_repaired",
+                                  report.set_keys + report.deleted_keys)
+                return report
+        except Exception as e:
+            # A cycle that left a checkpoint is resuming by design —
+            # "degraded", not "error" (which means the cycle lost its work).
+            trace.outcome = "degraded" if peer in self._sessions else "error"
+            if not trace.error:
+                trace.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            get_trace_buffer().append(CycleTrace(
+                cycle_id=cid, kind="pairwise", started_unix=started,
+                seconds=time.perf_counter() - t0, peers=[trace],
+            ))
 
     def _sync_once(
-        self, host: str, port: int, full: bool, verify: bool
+        self,
+        host: str,
+        port: int,
+        full: bool,
+        verify: bool,
+        trace: Optional[PeerTrace] = None,
     ) -> SyncReport:
         t0 = time.perf_counter()
         peer = f"{host}:{port}"
@@ -524,6 +559,18 @@ class SyncManager:
             report.bytes_received = client.bytes_received
             get_metrics().inc("sync.bytes_sent", report.bytes_sent)
             get_metrics().inc("sync.bytes_received", report.bytes_received)
+            if trace is not None:
+                trace.mode = report.mode
+                trace.bytes_sent = report.bytes_sent
+                trace.bytes_received = report.bytes_received
+                trace.rounds = report.rounds
+                trace.divergent = report.divergent
+                trace.repairs = report.set_keys + report.deleted_keys
+                if (peer in self._sessions
+                        or peer in self._degraded_this_cycle):
+                    trace.outcome = "degraded"
+                elif report.mode == "noop":
+                    trace.outcome = "noop"
             client.close()
             self._session_done(peer)
 
@@ -1340,17 +1387,39 @@ class SyncManager:
         full-transfer, and a deletion it hasn't replicated is undone
         forever (/root/reference/src/sync.rs:56-87,74-83).
         """
-        with span("anti_entropy.sync_multi", peers=",".join(peers)) as rec:
-            report = self._sync_multi(peers)
-            rec["divergent"] = report.divergent_union
-            get_metrics().inc("anti_entropy.multi_syncs")
-            get_metrics().inc(
-                "anti_entropy.keys_repaired",
-                report.set_keys + report.deleted_keys,
-            )
-            return report
+        traces = {p: PeerTrace(peer=p, mode="multi") for p in peers}
+        started, t0 = time.time(), time.perf_counter()
+        cid = next_cycle_id()
+        try:
+            with cycle_scope(cid), \
+                    span("anti_entropy.sync_multi",
+                         peers=",".join(peers)) as rec:
+                report = self._sync_multi(peers, traces=traces)
+                rec["divergent"] = report.divergent_union
+                get_metrics().inc("anti_entropy.multi_syncs")
+                get_metrics().inc(
+                    "anti_entropy.keys_repaired",
+                    report.set_keys + report.deleted_keys,
+                )
+                return report
+        except Exception as e:
+            for t in traces.values():
+                if t.outcome == "ok" and not t.error:
+                    t.outcome = "error"
+                    t.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            get_trace_buffer().append(CycleTrace(
+                cycle_id=cid, kind="multi", started_unix=started,
+                seconds=time.perf_counter() - t0,
+                peers=list(traces.values()),
+            ))
 
-    def _sync_multi(self, peers: list[str]) -> MultiSyncReport:
+    def _sync_multi(
+        self,
+        peers: list[str],
+        traces: Optional[dict[str, PeerTrace]] = None,
+    ) -> MultiSyncReport:
         import numpy as np
 
         from merklekv_tpu.merkle.diff import (
@@ -1367,7 +1436,12 @@ class SyncManager:
         clients: list[Optional[MerkleKVClient]] = []
         peer_hashes: list[dict[bytes, tuple[Optional[bytes], int]]] = []
 
-        def drop_peer(c: Optional[MerkleKVClient], why: str) -> None:
+        def drop_peer(
+            c: Optional[MerkleKVClient],
+            peer: str,
+            why: str,
+            outcome: str = "skipped",
+        ) -> None:
             # Every early-exit path must release the socket: this loop runs
             # every anti-entropy cycle, and an unclosed client per cycle is
             # a steady fd leak.
@@ -1376,6 +1450,12 @@ class SyncManager:
             report.details.append(why)
             clients.append(None)
             peer_hashes.append({})
+            if traces is not None:
+                traces[peer].outcome = outcome
+                traces[peer].error = why
+                if c is not None:
+                    traces[peer].bytes_sent = c.bytes_sent
+                    traces[peer].bytes_received = c.bytes_received
 
         for peer in peers:
             host, _, port = peer.rpartition(":")
@@ -1384,7 +1464,7 @@ class SyncManager:
                 c = MerkleKVClient(host, int(port), timeout=self._timeout)
                 c.connect()
             except Exception as e:
-                drop_peer(c, f"{peer}: unreachable ({e!r})")
+                drop_peer(c, peer, f"{peer}: unreachable ({e!r})")
                 continue
             # An interrupted repair from a previous cycle resumes before
             # this cycle's arbitration, so the local snapshot below already
@@ -1393,6 +1473,7 @@ class SyncManager:
             if sess is not None:
                 report.resumed_peers.append(peer)
                 get_metrics().inc("anti_entropy.sessions_resumed")
+                repairs_before = report.set_keys
                 try:
                     # cursor threads through so a re-checkpoint on failure
                     # keeps the paged walk's verified prefix — without it a
@@ -1405,16 +1486,25 @@ class SyncManager:
                         walk=sess.walk,
                     )
                 except Exception as e:
-                    drop_peer(c, f"{peer}: resume interrupted ({e!r})")
+                    if traces is not None:
+                        traces[peer].repairs += (
+                            report.set_keys - repairs_before
+                        )
+                    drop_peer(c, peer, f"{peer}: resume interrupted ({e!r})",
+                              outcome="degraded")
                     report.degraded.append(peer)
                     continue
+                if traces is not None:
+                    traces[peer].repairs += report.set_keys - repairs_before
                 if peer in self._sessions:
                     # Deadline expired mid-resume (silent checkpoint):
                     # arbitration for this peer would run on a spent budget
                     # and its wants-loop re-checkpoint would overwrite the
                     # saved paged-walk cursor with b"".
                     drop_peer(
-                        c, f"{peer}: deadline expired mid-resume; checkpointed"
+                        c, peer,
+                        f"{peer}: deadline expired mid-resume; checkpointed",
+                        outcome="degraded",
                     )
                     report.degraded.append(peer)
                     continue
@@ -1439,7 +1529,7 @@ class SyncManager:
                         f"{peer}: LEAFHASHES unsupported; full snapshot"
                     )
                 except Exception as e:
-                    drop_peer(c, f"{peer}: unreachable ({e!r})")
+                    drop_peer(c, peer, f"{peer}: unreachable ({e!r})")
                     continue
             clients.append(c)
             peer_hashes.append(decoded)
@@ -1495,6 +1585,9 @@ class SyncManager:
                 peers[i]: int(masks[slot].sum())
                 for slot, i in enumerate(live, start=1)
             }
+            if traces is not None:
+                for p, d in report.per_peer_divergent.items():
+                    traces[p].divergent = d
             divergent = np.nonzero(masks.any(axis=0))[0]
             report.divergent_union = int(len(divergent))
 
@@ -1592,6 +1685,7 @@ class SyncManager:
                 # cycle), it is marked degraded, and the other peers'
                 # repairs proceed.
                 peer = peers[r]
+                repairs_before = report.set_keys
                 try:
                     self._repair_sets_resumable(
                         clients[r], peer, pairs, report, deadline, lww=True
@@ -1599,12 +1693,23 @@ class SyncManager:
                 except Exception:
                     report.degraded.append(peer)
                     continue
+                finally:
+                    if traces is not None:
+                        traces[peer].repairs += (
+                            report.set_keys - repairs_before
+                        )
                 if peer in self._sessions:  # deadline checkpoint, no raise
                     report.degraded.append(peer)
         finally:
-            for c in clients:
+            for i, c in enumerate(clients):
                 if c is not None:
+                    if traces is not None:
+                        traces[peers[i]].bytes_sent = c.bytes_sent
+                        traces[peers[i]].bytes_received = c.bytes_received
                     c.close()
+            if traces is not None:
+                for p in report.degraded:
+                    traces[p].outcome = "degraded"
             for peer in peers:
                 self._session_done(peer)
 

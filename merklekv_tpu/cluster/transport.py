@@ -439,6 +439,12 @@ class TcpTransport:
     def publish(self, topic: str, payload: bytes) -> None:
         _publish_or_queue(self, topic, payload)
 
+    @property
+    def outbox_depth(self) -> int:
+        """Events queued awaiting a broker heal (the outbox-depth gauge)."""
+        with self._outbox_mu:
+            return len(self._outbox)
+
     def _wire_send(self, topic: str, payload: bytes) -> None:
         with self._send_mu:
             self._sock.sendall(_frame(topic, payload))

@@ -281,6 +281,12 @@ class MqttTransport:
     def publish(self, topic: str, payload: bytes) -> None:
         _publish_or_queue(self, topic, payload)
 
+    @property
+    def outbox_depth(self) -> int:
+        """Events queued awaiting a broker heal (the outbox-depth gauge)."""
+        with self._outbox_mu:
+            return len(self._outbox)
+
     def _wire_send(self, topic: str, payload: bytes) -> None:
         body = _utf8(topic) + payload  # QoS-0: no packet id
         with self._send_mu:

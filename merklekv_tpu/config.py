@@ -200,6 +200,23 @@ class StorageConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """[observability]: the metrics plane (merklekv_tpu/obs/).
+
+    ``http_port`` > 0 starts a per-node HTTP exporter serving Prometheus
+    text exposition at ``/metrics`` (+ ``/healthz``) — registry counters,
+    histograms, gauges, and the native STATS block bridged into one
+    namespace. 0 (default) disables the endpoint; the METRICS wire verb
+    and the TRACE ring buffer work either way. See docs/OBSERVABILITY.md.
+    """
+
+    http_port: int = 0  # 0 = disabled; -1 = ephemeral (tests)
+    http_host: str = "127.0.0.1"
+    # Ring-buffer capacity of the TRACE verb's cycle store.
+    trace_cycles: int = 128
+
+
+@dataclass
 class DeviceConfig:
     # Shard the serving Merkle tree's leaf level over ALL local JAX devices
     # (GSPMD over a "key" mesh). Single-device trees are the default; on a
@@ -218,6 +235,9 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig
+    )
 
     @classmethod
     def load(cls, path: str) -> "Config":
@@ -274,6 +294,18 @@ class Config:
         dev = raw.get("device", {})
         if "sharded_mirror" in dev:
             cfg.device.sharded_mirror = bool(dev["sharded_mirror"])
+        obs = raw.get("observability", {})
+        if "http_port" in obs:
+            cfg.observability.http_port = int(obs["http_port"])
+        if "http_host" in obs:
+            cfg.observability.http_host = str(obs["http_host"])
+        if "trace_cycles" in obs:
+            cfg.observability.trace_cycles = int(obs["trace_cycles"])
+        if cfg.observability.http_port < -1:
+            raise ValueError(
+                "[observability] http_port must be -1 (ephemeral), 0 "
+                f"(disabled), or a TCP port, got {cfg.observability.http_port}"
+            )
         st = raw.get("storage", {})
         for k in ("enabled", "snapshot_on_shutdown"):
             if k in st:

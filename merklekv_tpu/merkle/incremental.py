@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from merklekv_tpu.merkle.jax_engine import leaf_digests
+from merklekv_tpu.obs.metrics import get_metrics
 from merklekv_tpu.ops.dispatch import (
     hash_node_level,
     hash_node_pairs,
@@ -264,6 +265,11 @@ class DeviceMerkleState:
         self._flush()
         return len(self._keys)
 
+    def leaf_count(self) -> int:
+        """Built leaf count WITHOUT flushing staged changes — the gauge
+        path must never trigger device work."""
+        return len(self._keys)
+
     # ------------------------------------------------------------ lookups
     def _find(self, key: bytes) -> int:
         """Position of key in the sorted array, or -1."""
@@ -331,12 +337,29 @@ class DeviceMerkleState:
         if kb > k:
             blocks[k:] = blocks[0]
             nblocks[k:] = nblocks[0]
+        # Device-plane attribution (batch size + host->device transfer
+        # bytes): counters + a DISPATCH-latency histogram, no per-batch log
+        # line — a sustained drain flushes many times per second and span()
+        # would turn the log into the hot path. JAX dispatch is async, so
+        # the histogram measures trace+enqueue cost (queue-pressure
+        # signal), NOT on-device execution — forcing completion per batch
+        # (a host fetch) would serialize the very pipelining the drain
+        # depends on; end-to-end device time shows up in the spans that
+        # already force a root read (mirror warm, storage snapshot stamp).
+        import time as _time
+
+        t0 = _time.perf_counter()
         fn = _scatter_hash_fn(self._capacity, kb, nblk, use_pallas())
         self._levels = fn(
             self._levels, jnp.asarray(idx), jnp.asarray(blocks),
             jnp.asarray(nblocks),
         )
         self.incremental_batches += 1
+        m = get_metrics()
+        m.inc("device.scatter_keys", k)
+        m.inc("device.scatter_bytes",
+              int(blocks.nbytes + idx.nbytes + nblocks.nbytes))
+        m.observe("device.scatter_dispatch", _time.perf_counter() - t0)
 
     # ------------------------------------------------------------ structure
     def _capacity_for(self, n: int) -> int:
@@ -353,12 +376,19 @@ class DeviceMerkleState:
         )
 
     def _initial_build(self, keys_arr: np.ndarray, values: list) -> None:
+        from merklekv_tpu.utils.tracing import span
+
         n = len(keys_arr)
         c = self._capacity_for(n)
-        digests = np.asarray(leaf_digests(list(keys_arr), values))
-        padded = np.zeros((c, 8), np.uint32)
-        padded[:n] = digests
-        self._levels = _build_fn(c, use_pallas())(self._put(padded))
+        # Full rebuilds are rare (warm-up, empty->non-empty restructure) and
+        # expensive — a span records batch size and transfer bytes per the
+        # device-plane attribution the MTU throughput analysis needs.
+        with span("device.rebuild", keys=n, capacity=c) as rec:
+            digests = np.asarray(leaf_digests(list(keys_arr), values))
+            padded = np.zeros((c, 8), np.uint32)
+            padded[:n] = digests
+            rec["bytes"] = int(padded.nbytes)
+            self._levels = _build_fn(c, use_pallas())(self._put(padded))
         self._set_keys(keys_arr)
         self._capacity = c
         self.full_rebuilds += 1
@@ -425,6 +455,9 @@ class DeviceMerkleState:
             fresh_pos = np.zeros(0, np.int32)
             fresh = jnp.zeros((0, 8), jnp.uint32)
 
+        import time as _time
+
+        t0 = _time.perf_counter()
         fn = _restructure_fn(self._capacity, c_new, kb, use_pallas())
         self._levels = fn(
             self._levels[0], self._put(gather_padded, one_d=True),
@@ -433,6 +466,12 @@ class DeviceMerkleState:
         self._set_keys(new_keys)
         self._capacity = c_new
         self.structural_batches += 1
+        m = get_metrics()
+        m.inc("device.restructure_keys", k)
+        m.inc("device.restructure_bytes",
+              int(gather_padded.nbytes + fresh_pos.nbytes + k * 32))
+        # Dispatch latency, same async-enqueue semantics as scatter above.
+        m.observe("device.restructure_dispatch", _time.perf_counter() - t0)
 
     # ------------------------------------------------------------ queries
     def root_hash(self) -> Optional[bytes]:

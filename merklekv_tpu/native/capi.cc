@@ -207,6 +207,14 @@ long long mkv_engine_tomb_evictions(void* h) {
   return (long long)static_cast<Engine*>(h)->tomb_evictions();
 }
 
+// Engine mutation version (bumped per write). For engines that do not
+// track versions the base-class fallback increments per CALL — callers
+// comparing versions across reads (mirror-staleness gauge) should only do
+// so against the sharded/log engines, which track real mutation counts.
+unsigned long long mkv_engine_version(void* h) {
+  return (unsigned long long)static_cast<Engine*>(h)->version();
+}
+
 // 1 when a durable log refused to open because its on-disk format version
 // is newer than this binary (engine runs empty, logging disabled).
 int mkv_engine_log_version_refused(void* h) {
@@ -393,6 +401,12 @@ void mkv_server_set_cluster_cb(void* h, mkv_cluster_cb cb, void* ctx) {
 
 void mkv_server_enable_events(void* h, int on) {
   static_cast<ServerHandle*>(h)->server->set_events_enabled(on != 0);
+}
+
+// Command-latency histogram toggle (on by default); the off switch lets
+// bench.py A/B-measure the metrics plane's hot-path overhead.
+void mkv_server_enable_latency(void* h, int on) {
+  static_cast<ServerHandle*>(h)->server->set_latency_enabled(on != 0);
 }
 
 // Drain up to max_events change events. Serialization per event: u8 op,

@@ -181,6 +181,11 @@ ParseResult parse_command(const std::string& line) {
     if (u == "LEAFHASHES") { c.verb = Verb::LeafHashes; return ok(std::move(c)); }
     if (u == "PEERS") { c.verb = Verb::Peers; return ok(std::move(c)); }
     if (u == "METRICS") { c.verb = Verb::Metrics; return ok(std::move(c)); }
+    if (u == "TRACE") {
+      c.verb = Verb::Trace;
+      c.amount = 8;  // bare TRACE: a useful default window
+      return ok(std::move(c));
+    }
     if (u == "CLIENT") { c.verb = Verb::ClientList; return ok(std::move(c)); }
     if (u == "PING") { c.verb = Verb::Ping; return ok(std::move(c)); }
     if (u == "SHUTDOWN") { c.verb = Verb::Shutdown; return ok(std::move(c)); }
@@ -396,6 +401,18 @@ ParseResult parse_command(const std::string& line) {
     c.level = level;
     c.lo = lo;
     c.hi = hi;
+    return ok(std::move(c));
+  }
+  if (u == "TRACE") {
+    // "TRACE <n>" — newest n anti-entropy cycle traces.
+    auto toks = split_ws(rest);
+    int64_t n = 0;
+    if (toks.size() != 1 || !parse_i64_str(toks[0], &n) || n <= 0) {
+      return err("TRACE requires a positive integer count");
+    }
+    Command c;
+    c.verb = Verb::Trace;
+    c.amount = n;
     return ok(std::move(c));
   }
   if (u == "INC") return parse_numeric(Verb::Increment, "INC", rest);

@@ -37,6 +37,10 @@ enum class Verb {
   // control plane's failure detector.
   Peers,
   Metrics,
+  // Extension: "TRACE [n]" dumps the newest n anti-entropy cycles from the
+  // control plane's correlated-trace ring buffer (per-peer bytes/rounds/
+  // repairs/outcome per cycle). Without a cluster plane: "TRACES 0" + END.
+  Trace,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };

@@ -209,7 +209,21 @@ bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
       meta->last_cmd_unix.store(unix_now(), std::memory_order_relaxed);
       stats_.count(parsed.cmd);
       bool close_conn = false;
+      // Per-command dispatch latency: two steady_clock reads + one relaxed
+      // atomic add per command (~50 ns against a multi-us dispatch) feed
+      // the lock-free histogram behind STATS cmd_latency_us_* — cheap
+      // enough to stay on by default on the SET hot path (bench.py
+      // measures the overhead; set_latency_enabled is the A/B switch).
+      const bool timed = latency_enabled_.load(std::memory_order_acquire);
+      const auto t0 = timed ? std::chrono::steady_clock::now()
+                            : std::chrono::steady_clock::time_point{};
       std::string response = dispatch(parsed.cmd, &close_conn);
+      if (timed) {
+        stats_.latency.observe_ns(uint64_t(
+            std::chrono::duration_cast<std::chrono::nanoseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count()));
+      }
       if (!send_all(fd, response)) return false;
       if (close_conn) return true;
     }
@@ -318,6 +332,21 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         if (!resp.empty()) return resp;
       }
       return "METRICS\r\nEND\r\n";
+    }
+    case Verb::Trace: {
+      // Correlated anti-entropy cycle traces from the control plane's ring
+      // buffer (extension verb; per-peer bytes/rounds/repairs/outcome).
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp =
+            cb("TRACE " + std::to_string(cmd.amount.value_or(8)));
+        if (!resp.empty()) return resp;
+      }
+      return "TRACES 0\r\nEND\r\n";
     }
     case Verb::Sync:
     case Verb::Replicate: {

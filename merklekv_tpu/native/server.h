@@ -79,6 +79,11 @@ class Server {
   bool events_enabled() const {
     return events_enabled_.load(std::memory_order_acquire);
   }
+  // Command-latency histogram toggle (on by default). The off switch exists
+  // so the metrics plane's hot-path overhead is A/B-measurable in bench.py.
+  void set_latency_enabled(bool on) {
+    latency_enabled_.store(on, std::memory_order_release);
+  }
 
  private:
   void accept_loop();
@@ -97,6 +102,7 @@ class Server {
   ServerStats stats_;
   EventQueue events_;
   std::atomic<bool> events_enabled_{false};
+  std::atomic<bool> latency_enabled_{true};
   static constexpr size_t kWriteStripes = 64;
   std::mutex write_stripes_[kWriteStripes];
   std::atomic<int> listen_fd_{-1};

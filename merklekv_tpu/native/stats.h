@@ -18,6 +18,30 @@
 
 namespace mkv {
 
+// Lock-free command-latency histogram: fixed log2 buckets over
+// MICROSECONDS (upper bounds 1, 2, 4, ..., 2^21 us ≈ 2.1 s, then +inf) —
+// the same bound ladder as the Python registry's seconds buckets
+// (obs/metrics.py), so the exporter merges both into one namespace.
+// Observation is one relaxed atomic add per command; the buckets travel in
+// STATS as raw (non-cumulative) counts `cmd_latency_us_le_<bound>` plus
+// `cmd_latency_us_sum` / `cmd_latency_us_count`, and p50/p90/p99 are
+// derivable from the counts on any scrape.
+struct LatencyHisto {
+  static constexpr int kBuckets = 22;  // le = 2^0 .. 2^21 us; [22] = +inf
+  std::atomic<uint64_t> buckets[kBuckets + 1]{};
+  std::atomic<uint64_t> sum_us{0};
+  std::atomic<uint64_t> count{0};
+
+  void observe_ns(uint64_t ns) {
+    uint64_t us = ns / 1000;
+    int i = 0;
+    while (i < kBuckets && us > (uint64_t(1) << i)) ++i;
+    buckets[i].fetch_add(1, std::memory_order_relaxed);
+    sum_us.fetch_add(us, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+  }
+};
+
 struct ServerStats {
   using Clock = std::chrono::steady_clock;
   Clock::time_point start_time = Clock::now();
@@ -44,6 +68,8 @@ struct ServerStats {
   std::atomic<uint64_t> hash_commands{0};
   std::atomic<uint64_t> replicate_commands{0};
   std::atomic<uint64_t> management_commands{0};
+
+  LatencyHisto latency;
 
   uint64_t uptime_seconds() const {
     return uint64_t(std::chrono::duration_cast<std::chrono::seconds>(
@@ -89,6 +115,7 @@ struct ServerStats {
       case Verb::Memory: memory_commands++; break;
       case Verb::Peers: management_commands++; break;
       case Verb::Metrics: management_commands++; break;
+      case Verb::Trace: management_commands++; break;
       case Verb::Sync: sync_commands++; break;
       case Verb::Hash:
       case Verb::LeafHashes:
@@ -148,6 +175,20 @@ struct ServerStats {
     add("replicate_commands", ld(replicate_commands));
     add("management_commands", ld(management_commands));
     add("used_memory_kb", rss_kb());
+    // Command-latency histogram (extension lines; see LatencyHisto).
+    char name[64];
+    for (int i = 0; i < LatencyHisto::kBuckets; ++i) {
+      std::snprintf(name, sizeof(name), "cmd_latency_us_le_%llu",
+                    (unsigned long long)(uint64_t(1) << i));
+      add(name, latency.buckets[i].load(std::memory_order_relaxed));
+    }
+    add("cmd_latency_us_le_inf",
+        latency.buckets[LatencyHisto::kBuckets].load(
+            std::memory_order_relaxed));
+    add("cmd_latency_us_sum",
+        latency.sum_us.load(std::memory_order_relaxed));
+    add("cmd_latency_us_count",
+        latency.count.load(std::memory_order_relaxed));
     return out;
   }
 };

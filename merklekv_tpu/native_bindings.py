@@ -120,6 +120,8 @@ def _load() -> ctypes.CDLL:
     lib.mkv_engine_memory_usage.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_tomb_evictions.restype = ctypes.c_longlong
     lib.mkv_engine_tomb_evictions.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_version.restype = ctypes.c_ulonglong
+    lib.mkv_engine_version.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_log_version_refused.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_truncate.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_compact.argtypes = [ctypes.c_void_p]
@@ -159,6 +161,7 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p, _CLUSTER_CB, ctypes.c_void_p,
     ]
     lib.mkv_server_enable_events.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mkv_server_enable_latency.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mkv_server_drain_events.argtypes = [
         ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
     ]
@@ -325,6 +328,13 @@ class NativeEngine:
 
     def memory_usage(self) -> int:
         return self._lib.mkv_engine_memory_usage(self._h)
+
+    def version(self) -> int:
+        """Engine mutation version (bumped per write). Only the sharded
+        ("mem") and log engines track real versions; other kinds fall back
+        to a bump-per-CALL counter, so cross-read comparisons (the mirror
+        staleness gauge) are only meaningful on version-tracking engines."""
+        return int(self._lib.mkv_engine_version(self._h))
 
     def tomb_evictions(self) -> int:
         """Deletion records dropped by the bounded tombstone map — each one
@@ -528,6 +538,11 @@ class NativeServer:
         drainer the queue would pin keys+values for up to 2^20 writes."""
         self._lib.mkv_server_enable_events(self._h, 1 if on else 0)
 
+    def enable_latency(self, on: bool = True) -> None:
+        """Toggle the native command-latency histogram (on by default);
+        bench.py flips it off to A/B the metrics plane's hot-path cost."""
+        self._lib.mkv_server_enable_latency(self._h, 1 if on else 0)
+
     def drain_events(self, max_events: int = 0) -> list[ChangeEventRaw]:
         out = ctypes.c_void_p()
         out_len = ctypes.c_longlong()
@@ -556,6 +571,11 @@ class NativeServer:
         return self._lib.mkv_server_events_dropped(self._h)
 
     def stats_text(self) -> str:
+        if not self._h:
+            # A /metrics scrape can race server teardown (exporter handler
+            # threads outlive node.stop() ordering mistakes); an empty
+            # block beats driving the FFI through a dead handle.
+            return ""
         out = ctypes.c_void_p()
         out_len = ctypes.c_int()
         self._lib.mkv_server_stats(self._h, ctypes.byref(out), ctypes.byref(out_len))

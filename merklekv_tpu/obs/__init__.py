@@ -1,0 +1,50 @@
+"""Observability plane: metrics registry, Prometheus exporter, sync traces.
+
+Subsumes and extends ``utils/tracing.py`` (which keeps its ``span`` /
+``get_metrics`` API, now backed by this package's registry):
+
+- ``obs.metrics`` — counters, fixed-log-bucket histograms (percentiles
+  derivable from buckets), callback gauges;
+- ``obs.exporter`` — per-node HTTP ``/metrics`` (Prometheus text
+  exposition) + ``/healthz``, bridging native STATS into one namespace;
+- ``obs.trace``  — anti-entropy cycle ids (stamped into every span) and
+  the per-peer ring buffer behind the ``TRACE <n>`` wire verb;
+- ``obs.top``    — the ``python -m merklekv_tpu top`` terminal dashboard.
+
+See docs/OBSERVABILITY.md for the metric catalog and scrape setup.
+"""
+
+from merklekv_tpu.obs.exporter import MetricsExporter, render_prometheus
+from merklekv_tpu.obs.metrics import (
+    BUCKET_BOUNDS,
+    Histogram,
+    Metrics,
+    bucket_index,
+    get_metrics,
+)
+from merklekv_tpu.obs.trace import (
+    CycleTrace,
+    PeerTrace,
+    SyncTraceBuffer,
+    current_cycle_id,
+    cycle_scope,
+    get_trace_buffer,
+    next_cycle_id,
+)
+
+__all__ = [
+    "BUCKET_BOUNDS",
+    "bucket_index",
+    "Histogram",
+    "Metrics",
+    "get_metrics",
+    "MetricsExporter",
+    "render_prometheus",
+    "CycleTrace",
+    "PeerTrace",
+    "SyncTraceBuffer",
+    "current_cycle_id",
+    "cycle_scope",
+    "get_trace_buffer",
+    "next_cycle_id",
+]

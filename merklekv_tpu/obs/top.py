@@ -1,0 +1,184 @@
+"""``python -m merklekv_tpu top`` — live cluster dashboard in the terminal.
+
+Polls STATS / INFO / METRICS / PEERS across a node list over the normal
+wire protocol (no exporter needed), computes per-interval rates from
+successive counter samples, and renders one table per refresh:
+
+    NODE              KEYS     OPS/S   SET/S   GET/S  P50_US  SYNC_KB/S  CONN  PEERS_UP  STATUS
+
+``--once`` prints a single frame (two quick samples for rates) and exits —
+scriptable and testable; without it the screen refreshes every
+``--interval`` seconds until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from merklekv_tpu.client import MerkleKVClient, MerkleKVError
+
+__all__ = ["NodeSample", "sample_node", "render_table", "main"]
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+@dataclass
+class NodeSample:
+    node: str
+    ok: bool = False
+    error: str = ""
+    unix: float = field(default_factory=time.time)
+    keys: int = 0
+    total_commands: int = 0
+    set_commands: int = 0
+    get_commands: int = 0
+    active_connections: int = 0
+    sync_bytes: int = 0  # sync.bytes_sent + sync.bytes_received
+    syncs: int = 0
+    latency_p50_us: Optional[float] = None
+    peers_up: int = 0
+    peers_total: int = 0
+
+
+def _p50_from_stats(stats: dict[str, str]) -> Optional[float]:
+    """Native command-latency p50 (µs) from the raw cmd_latency_us_le_*
+    bucket counts in STATS; None when the server predates them."""
+    buckets = []
+    for name, value in stats.items():
+        if not name.startswith("cmd_latency_us_le_"):
+            continue
+        bound = name[len("cmd_latency_us_le_"):]
+        try:
+            buckets.append(
+                (float("inf") if bound == "inf" else int(bound), int(value))
+            )
+        except ValueError:
+            continue
+    if not buckets:
+        return None
+    buckets.sort(key=lambda b: b[0])
+    total = sum(c for _, c in buckets)
+    if total == 0:
+        return None
+    rank, running = (total + 1) // 2, 0
+    for bound, c in buckets:
+        running += c
+        if running >= rank:
+            return float(bound)
+    return None
+
+
+def sample_node(node: str, timeout: float = 2.0) -> NodeSample:
+    host, _, port = node.rpartition(":")
+    s = NodeSample(node=node)
+    try:
+        with MerkleKVClient(host, int(port), timeout=timeout) as c:
+            stats = c.stats()
+            info = c.info()
+            metrics = c.metrics()
+            peers = c.peers()
+    except (MerkleKVError, OSError, ValueError) as e:
+        s.error = f"{type(e).__name__}: {e}"
+        return s
+    s.ok = True
+    s.keys = int(info.get("db_keys", 0) or 0)
+    s.total_commands = int(stats.get("total_commands", 0) or 0)
+    s.set_commands = int(stats.get("set_commands", 0) or 0)
+    s.get_commands = int(stats.get("get_commands", 0) or 0)
+    s.active_connections = int(stats.get("active_connections", 0) or 0)
+    s.latency_p50_us = _p50_from_stats(stats)
+    s.sync_bytes = int(metrics.get("sync.bytes_sent", 0) or 0) + int(
+        metrics.get("sync.bytes_received", 0) or 0
+    )
+    s.syncs = int(metrics.get("anti_entropy.syncs", 0) or 0) + int(
+        metrics.get("anti_entropy.multi_syncs", 0) or 0
+    )
+    s.peers_total = len(peers)
+    s.peers_up = sum(1 for p in peers if p.get("status") == "up")
+    return s
+
+
+def _rate(cur: int, prev: int, dt: float) -> float:
+    return max(0.0, (cur - prev) / dt) if dt > 0 else 0.0
+
+
+def render_table(
+    prev: dict[str, NodeSample], cur: dict[str, NodeSample]
+) -> str:
+    header = (
+        f"{'NODE':<22} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} {'GET/S':>8} "
+        f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONN':>5} {'PEERS_UP':>9} STATUS"
+    )
+    lines = [header, "-" * len(header)]
+    for node in cur:
+        c = cur[node]
+        p = prev.get(node)
+        if not c.ok:
+            lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
+                         f"{'-':>7} {'-':>10} {'-':>5} {'-':>9} "
+                         f"DOWN ({c.error})")
+            continue
+        dt = (c.unix - p.unix) if (p is not None and p.ok) else 0.0
+        ops = _rate(c.total_commands, p.total_commands, dt) if dt else 0.0
+        sets = _rate(c.set_commands, p.set_commands, dt) if dt else 0.0
+        gets = _rate(c.get_commands, p.get_commands, dt) if dt else 0.0
+        sync_kb = (
+            _rate(c.sync_bytes, p.sync_bytes, dt) / 1024.0 if dt else 0.0
+        )
+        p50 = f"{c.latency_p50_us:.0f}" if c.latency_p50_us else "-"
+        peers = (
+            f"{c.peers_up}/{c.peers_total}" if c.peers_total else "-"
+        )
+        lines.append(
+            f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
+            f"{p50:>7} {sync_kb:>10.1f} {c.active_connections:>5} "
+            f"{peers:>9} UP"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="merklekv_tpu top",
+        description="live METRICS/STATS/PEERS dashboard over a node list",
+    )
+    p.add_argument(
+        "--nodes",
+        required=True,
+        help="comma-separated host:port list to poll",
+    )
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument(
+        "--once",
+        action="store_true",
+        help="print one frame (two samples, interval apart) and exit",
+    )
+    p.add_argument("--timeout", type=float, default=2.0)
+    args = p.parse_args(argv)
+    nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
+    if not nodes:
+        print("no nodes given", file=sys.stderr)
+        return 2
+
+    def take() -> dict[str, NodeSample]:
+        return {n: sample_node(n, timeout=args.timeout) for n in nodes}
+
+    prev = take()
+    try:
+        while True:
+            time.sleep(max(0.05, args.interval))
+            cur = take()
+            frame = render_table(prev, cur)
+            if args.once:
+                print(frame, flush=True)
+                return 0
+            sys.stdout.write(_CLEAR + time.strftime("%H:%M:%S ")
+                             + f"interval={args.interval:g}s\n" + frame + "\n")
+            sys.stdout.flush()
+            prev = cur
+    except KeyboardInterrupt:
+        return 0

@@ -2,13 +2,18 @@
 
 The reference has no tracing at all — just env_logger text logs, with
 structured tracing/Prometheus listed as an open roadmap issue
-(/root/reference/README.md:1902-1906). Here observability is first-class:
+(/root/reference/README.md:1902-1906). Here observability is first-class;
+the metrics core lives in ``merklekv_tpu/obs/`` (histograms, gauges, the
+Prometheus exporter) and this module keeps the thin tracing API every
+subsystem imports:
 
 - ``span("name")`` context manager: wall-time spans emitted as single-line
-  JSON records through the ``merklekv`` logger and aggregated into
-  per-span counters/totals;
-- ``get_metrics()``: process-wide registry (counters + span stats) that
-  subsystems (replicator, sync manager) bump; snapshot() for dashboards
+  JSON records through the ``merklekv`` logger, aggregated into per-span
+  counters/totals AND per-span latency histograms (obs.metrics), and
+  stamped with the current anti-entropy cycle id when one is active
+  (obs.trace) so a cycle's spans correlate in the log stream;
+- ``get_metrics()``: the process-wide obs registry (counters + spans +
+  histograms + gauges) that subsystems bump; snapshot() for dashboards
   and the test suite;
 - ``device_profile(logdir)``: wraps ``jax.profiler.trace`` so a TPU trace
   of the Merkle data plane is one ``with`` block (inspect with
@@ -19,62 +24,16 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
 import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
 
+from merklekv_tpu.obs.metrics import Metrics, get_metrics
+from merklekv_tpu.obs.trace import current_cycle_id
+
 logger = logging.getLogger("merklekv")
 
 __all__ = ["span", "Metrics", "get_metrics", "device_profile"]
-
-
-class Metrics:
-    """Thread-safe counters + span aggregates."""
-
-    def __init__(self) -> None:
-        self._mu = threading.Lock()
-        self._counters: dict[str, int] = {}
-        self._span_count: dict[str, int] = {}
-        self._span_total_s: dict[str, float] = {}
-
-    def inc(self, name: str, delta: int = 1) -> None:
-        with self._mu:
-            self._counters[name] = self._counters.get(name, 0) + delta
-
-    def observe_span(self, name: str, seconds: float) -> None:
-        with self._mu:
-            self._span_count[name] = self._span_count.get(name, 0) + 1
-            self._span_total_s[name] = self._span_total_s.get(name, 0.0) + seconds
-
-    def snapshot(self) -> dict:
-        with self._mu:
-            return {
-                "counters": dict(self._counters),
-                "spans": {
-                    name: {
-                        "count": self._span_count[name],
-                        "total_s": round(self._span_total_s[name], 6),
-                        "avg_s": round(
-                            self._span_total_s[name] / self._span_count[name], 6
-                        ),
-                    }
-                    for name in self._span_count
-                },
-            }
-
-    def reset(self) -> None:
-        with self._mu:
-            self._counters.clear()
-            self._span_count.clear()
-            self._span_total_s.clear()
-
-
-_metrics = Metrics()
-
-
-def get_metrics() -> Metrics:
-    return _metrics
 
 
 @contextmanager
@@ -90,8 +49,12 @@ def span(name: str, **fields) -> Iterator[dict]:
         raise
     finally:
         dt = time.perf_counter() - t0
+        _metrics = get_metrics()
         _metrics.observe_span(name, dt)
         record = {"span": name, "seconds": round(dt, 6), **fields, **extra}
+        cycle = current_cycle_id()
+        if cycle is not None and "cycle" not in record:
+            record["cycle"] = cycle
         if error is not None:
             record["error"] = error
         logger.info(json.dumps(record, default=str))

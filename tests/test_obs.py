@@ -1,0 +1,439 @@
+"""Observability plane (merklekv_tpu/obs/): histogram bucket math,
+callback gauges, Prometheus exporter scrape-format validation,
+METRICS/STATS parity across clients, correlated TRACE cycles, the
+span total_us fix, and the `top` dashboard renderer."""
+
+import asyncio
+import json
+import math
+import re
+import time
+import urllib.request
+
+import pytest
+
+from merklekv_tpu.client import AsyncMerkleKVClient, MerkleKVClient
+from merklekv_tpu.cluster.node import ClusterNode
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+from merklekv_tpu.obs.exporter import render_prometheus
+from merklekv_tpu.obs.metrics import (
+    BUCKET_BOUNDS,
+    Histogram,
+    Metrics,
+    bucket_index,
+)
+from merklekv_tpu.obs.trace import CycleTrace, PeerTrace, SyncTraceBuffer
+from merklekv_tpu.utils.tracing import get_metrics, span
+
+
+@pytest.fixture
+def server():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    yield eng, srv
+    srv.close()
+    eng.close()
+
+
+@pytest.fixture
+def cluster_node(server):
+    """A ClusterNode with an ephemeral-port exporter attached."""
+    eng, srv = server
+    cfg = Config()
+    cfg.observability.http_port = -1  # ephemeral
+    cfg.anti_entropy.engine = "cpu"
+    node = ClusterNode(cfg, eng, srv)
+    node.start()
+    yield eng, srv, node
+    node.stop()
+
+
+# --------------------------------------------------------- histogram math
+
+def test_bucket_bounds_are_log2_from_1us():
+    assert BUCKET_BOUNDS[0] == 1e-6
+    for lo, hi in zip(BUCKET_BOUNDS, BUCKET_BOUNDS[1:]):
+        assert hi == lo * 2
+
+
+def test_bucket_index_golden():
+    # (observation seconds, expected bucket index) — le semantics: the
+    # first bound >= the value wins; over the top bound = overflow slot.
+    golden = [
+        (0.0, 0),
+        (5e-7, 0),
+        (1e-6, 0),
+        (1.0001e-6, 1),
+        (2e-6, 1),
+        (3e-6, 2),
+        (4e-6, 2),
+        (1e-3, 10),       # 1024 us bound
+        (0.5, 19),        # 0.524288 s bound
+        (BUCKET_BOUNDS[-1], len(BUCKET_BOUNDS) - 1),
+        (BUCKET_BOUNDS[-1] * 2, len(BUCKET_BOUNDS)),  # +Inf overflow
+    ]
+    for value, want in golden:
+        assert bucket_index(value) == want, (value, bucket_index(value), want)
+
+
+def test_bucket_index_exact_bounds_never_spill():
+    for i, bound in enumerate(BUCKET_BOUNDS):
+        assert bucket_index(bound) == i
+
+
+def test_histogram_quantiles_and_cumulative():
+    h = Histogram()
+    for _ in range(99):
+        h.observe(10e-6)  # -> le 1.6e-05 bucket
+    h.observe(1.0)        # one slow outlier -> le 1.048576
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["max"] == 1.0
+    assert abs(snap["sum"] - (99 * 10e-6 + 1.0)) < 1e-9
+    # p50/p90 sit in the 16us bucket; p99 still below the outlier; max/p100
+    # reaches the outlier's bucket bound.
+    assert h.quantile(0.5) == pytest.approx(1.6e-5)
+    assert h.quantile(0.9) == pytest.approx(1.6e-5)
+    assert h.quantile(0.99) == pytest.approx(1.6e-5)
+    assert h.quantile(1.0) == pytest.approx(1.048576)
+    # Cumulative view is monotone and ends at (inf, count).
+    cum = h.cumulative()
+    assert cum[-1] == (math.inf, 100)
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts)
+
+
+def test_histogram_empty_quantile_is_none():
+    assert Histogram().quantile(0.5) is None
+
+
+def test_overflow_quantile_reports_observed_max():
+    h = Histogram()
+    h.observe(100.0)  # beyond the last bound
+    assert h.quantile(0.5) == 100.0
+
+
+# --------------------------------------------------------------- gauges
+
+def test_gauges_register_snapshot_unregister():
+    m = Metrics()
+    m.register_gauge("g.num", lambda: 7, help="seven")
+    m.register_gauge("g.map", lambda: {"a": 1.5}, label="peer")
+    m.register_gauge("g.boom", lambda: 1 / 0)
+    snap = m.gauges_snapshot()
+    assert snap["g.num"]["value"] == 7
+    assert snap["g.map"]["value"] == {"a": 1.5}
+    assert snap["g.map"]["label"] == "peer"
+    assert "g.boom" not in snap  # failing callback drops ITS gauge only
+    m.unregister_gauge("g.num")
+    assert "g.num" not in m.gauges_snapshot()
+
+
+def test_unregister_gauge_is_identity_checked():
+    """A stopped node must not strip a successor's same-named gauge
+    (registration is last-wins across nodes in one process)."""
+    m = Metrics()
+    fn_a, fn_b = (lambda: 1), (lambda: 2)
+    m.register_gauge("g", fn_a)
+    m.register_gauge("g", fn_b)  # node B replaces node A
+    m.unregister_gauge("g", fn_a)  # node A stops: B's registration survives
+    assert m.gauges_snapshot()["g"]["value"] == 2
+    m.unregister_gauge("g", fn_b)
+    assert "g" not in m.gauges_snapshot()
+
+
+def test_reset_clears_series_but_keeps_gauges():
+    m = Metrics()
+    m.inc("c", 3)
+    m.observe("h", 0.001)
+    m.register_gauge("g", lambda: 1)
+    m.reset()
+    snap = m.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert "g" in m.gauges_snapshot()  # live callbacks survive reset
+
+
+# ------------------------------------------------- exporter text format
+
+# Prometheus text-format grammar (v0.0.4): comment/TYPE/HELP lines, or
+# `name{label="value",...} value [timestamp]` samples.
+_PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\")*\})?"
+    r" (?:[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|\+Inf|-Inf|NaN)"
+    r"(?: [0-9]+)?$"
+)
+
+
+def _assert_prometheus_grammar(body: str) -> None:
+    for line in body.splitlines():
+        if not line:
+            continue
+        assert _PROM_COMMENT.match(line) or _PROM_SAMPLE.match(line), (
+            f"line fails Prometheus text grammar: {line!r}"
+        )
+
+
+def test_render_prometheus_grammar_full_surface():
+    m = Metrics()
+    m.inc("anti_entropy.syncs", 2)
+    m.observe_span("anti_entropy.sync_once", 0.01)
+    m.observe("storage.wal_fsync", 0.0005)
+    m.register_gauge("keyspace.keys", lambda: 42, help="Live keys.")
+    m.register_gauge(
+        "peer.state", lambda: {"127.0.0.1:7379": 2}, label="peer"
+    )
+    stats_text = (
+        "STATS\r\nset_commands:4\r\nuptime:0d 0h 0m 1s\r\n"
+        "cmd_latency_us_le_1:2\r\ncmd_latency_us_le_2:1\r\n"
+        "cmd_latency_us_le_inf:0\r\ncmd_latency_us_sum:5\r\n"
+        "cmd_latency_us_count:3\r\nEND\r\n"
+    )
+    body = render_prometheus(m, stats_text)
+    _assert_prometheus_grammar(body)
+    assert "mkv_anti_entropy_syncs_total 2" in body
+    assert 'mkv_span_duration_seconds_bucket{span="anti_entropy.sync_once"' \
+        in body
+    assert "mkv_storage_wal_fsync_seconds_count 1" in body
+    assert "mkv_keyspace_keys 42" in body
+    assert 'mkv_peer_state{peer="127.0.0.1:7379"} 2' in body
+    assert "mkv_native_set_commands 4" in body
+    # The native latency buckets fold into one cumulative histogram.
+    assert 'mkv_native_cmd_latency_seconds_bucket{le="2e-06"} 3' in body
+    assert "mkv_native_cmd_latency_seconds_count 3" in body
+    # Human-readable native lines are skipped, not mangled.
+    assert "uptime:0d" not in body
+
+
+def test_exporter_endpoint_two_node_cluster(cluster_node):
+    """Acceptance shape: a 2-node cluster under write + anti-entropy load
+    serves a Prometheus-parseable /metrics page with histogram series, a
+    gauge, and bridged native counters; TRACE 5 attributes the cycles."""
+    eng_b, srv_b, node = cluster_node
+    eng_a = NativeEngine("mem")
+    srv_a = NativeServer(eng_a, "127.0.0.1", 0)
+    srv_a.start()
+    try:
+        for i in range(64):
+            eng_a.set(b"obs:%04d" % i, b"v%d" % i)
+        with MerkleKVClient("127.0.0.1", srv_b.port) as c:
+            for i in range(16):
+                c.set(f"local:{i:03d}", f"w{i}")
+            assert c.sync_with("127.0.0.1", srv_a.port)
+            assert c.sync_with("127.0.0.1", srv_a.port)  # converged: noop
+            rows = c.trace(5)
+        assert rows, "TRACE returned no cycles"
+        newest = rows[0]
+        for field in ("cycle", "peer", "mode", "outcome", "bytes_sent",
+                      "bytes_received", "rounds", "repairs"):
+            assert field in newest, f"TRACE row missing {field}"
+        assert newest["peer"] == f"127.0.0.1:{srv_a.port}"
+        assert newest["outcome"] == "noop"
+        repaired = next(r for r in rows if r["outcome"] == "ok")
+        assert int(repaired["repairs"]) >= 64
+        assert int(repaired["bytes_received"]) > 0
+
+        port = node.metrics_port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        _assert_prometheus_grammar(body)
+        # At least one histogram with _bucket/_sum/_count series.
+        assert 'mkv_span_duration_seconds_bucket{span="anti_entropy.' in body
+        assert "mkv_span_duration_seconds_sum" in body
+        assert "mkv_span_duration_seconds_count" in body
+        # A gauge over live node state.
+        key_line = next(
+            ln for ln in body.splitlines()
+            if ln.startswith("mkv_keyspace_keys ")
+        )
+        assert float(key_line.split()[1]) == eng_b.dbsize()
+        # Native STATS bridged into the same namespace.
+        assert "mkv_native_set_commands" in body
+        assert "mkv_native_cmd_latency_seconds_bucket" in body
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read().decode())
+        assert payload["status"] == "ok"
+        assert payload["keys"] == eng_b.dbsize()
+    finally:
+        srv_a.close()
+        eng_a.close()
+
+
+def test_exporter_404_on_unknown_path(cluster_node):
+    _, _, node = cluster_node
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{node.metrics_port}/nope", timeout=5
+        )
+    assert exc.value.code == 404
+
+
+# --------------------------------------------- METRICS / STATS parity
+
+def test_metrics_native_only_node_serves_empty_block(server):
+    """Without a cluster plane METRICS is an empty block on BOTH clients
+    (native default), and STATS parses identically too."""
+    _, srv = server
+
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        assert c.metrics() == {}
+        sync_stats = c.stats()
+
+    async def go():
+        async with AsyncMerkleKVClient("127.0.0.1", srv.port) as ac:
+            return await ac.metrics(), await ac.stats()
+
+    async_metrics, async_stats = asyncio.run(go())
+    assert async_metrics == {}
+    assert set(async_stats) == set(sync_stats)
+
+
+def test_metrics_parity_sync_async_cluster_attached(cluster_node):
+    """Cluster-attached node serves control-plane counters; the sync and
+    async clients parse the identical block (sentinel counter equality)."""
+    _, srv, _node = cluster_node
+    get_metrics().inc("obs_parity.sentinel", 41)
+
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        sync_m = c.metrics()
+
+    async def go():
+        async with AsyncMerkleKVClient("127.0.0.1", srv.port) as ac:
+            return await ac.metrics()
+
+    async_m = asyncio.run(go())
+    assert sync_m.get("obs_parity.sentinel") == "41"
+    assert async_m.get("obs_parity.sentinel") == "41"
+    assert set(sync_m) == set(async_m)
+
+
+def test_span_total_us_not_truncated(cluster_node):
+    """Satellite: sub-millisecond spans used to report total_ms 0 — the
+    canonical total is now microseconds (total_ms kept one release,
+    deprecated in PROTOCOL.md)."""
+    _, srv, _node = cluster_node
+    get_metrics().reset()
+    # Deterministic sub-ms observation (a sleep-based span can overshoot
+    # 1 ms under CI load and void the truncation assertion).
+    get_metrics().observe_span("obs_tiny.op", 0.0003)
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        m = c.metrics()
+    assert int(m["span.obs_tiny.op.total_us"]) > 0
+    assert int(m["span.obs_tiny.op.total_ms"]) == 0  # the bug being fixed
+    assert int(m["span.obs_tiny.op.p50_us"]) > 0
+
+
+# ----------------------------------------------------------- TRACE ring
+
+def test_trace_verb_without_cluster_plane(server):
+    _, srv = server
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        assert c.trace(5) == []  # native default: empty table
+
+
+def test_trace_ring_buffer_capacity_and_order():
+    buf = SyncTraceBuffer(capacity=3)
+    for i in range(1, 6):
+        buf.append(CycleTrace(cycle_id=i, kind="pairwise",
+                              peers=[PeerTrace(peer="p:1")]))
+    assert len(buf) == 3
+    assert [c.cycle_id for c in buf.last(10)] == [5, 4, 3]  # newest first
+    wire = buf.wire_dump(2)
+    assert wire.startswith("TRACES 2\r\n") and wire.endswith("END\r\n")
+    assert "cycle=5" in wire and "cycle=3" not in wire
+
+
+def test_trace_records_error_outcome(server):
+    """A cycle against a dead peer lands in the ring buffer as an error."""
+    from merklekv_tpu.cluster.sync import SyncManager
+    from merklekv_tpu.obs.trace import get_trace_buffer
+
+    eng, srv = server
+    dead = NativeServer(eng, "127.0.0.1", 0)
+    dead.start()
+    port = dead.port
+    dead.close()
+    mgr = SyncManager(eng, device="cpu")
+    before = len(get_trace_buffer())
+    with pytest.raises(Exception):
+        mgr.sync_once("127.0.0.1", port)
+    cycles = get_trace_buffer().last(len(get_trace_buffer()) - before + 1)
+    mine = next(c for c in cycles if c.peers
+                and c.peers[0].peer == f"127.0.0.1:{port}")
+    assert mine.peers[0].outcome == "error"
+    assert mine.peers[0].error
+
+
+def test_cycle_id_stamped_into_spans(server, caplog):
+    import logging
+
+    from merklekv_tpu.cluster.sync import SyncManager
+
+    eng, srv = server
+    eng.set(b"c", b"v")
+    local = NativeEngine("mem")
+    try:
+        with caplog.at_level(logging.INFO, logger="merklekv"):
+            SyncManager(local, device="cpu").sync_once(
+                "127.0.0.1", srv.port
+            )
+        spans = [json.loads(r.message) for r in caplog.records
+                 if r.message.startswith("{")]
+        cycle_spans = [s for s in spans
+                       if s.get("span") == "anti_entropy.sync_once"]
+        assert cycle_spans and all("cycle" in s for s in cycle_spans)
+    finally:
+        local.close()
+
+
+# ----------------------------------------------------------------- top
+
+def test_top_sample_and_render(server):
+    from merklekv_tpu.obs import top as topmod
+
+    eng, srv = server
+    node = f"127.0.0.1:{srv.port}"
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        for i in range(10):
+            c.set(f"t:{i}", "v")
+    s0 = topmod.sample_node(node)
+    assert s0.ok and s0.keys == 10
+    assert s0.latency_p50_us is not None and s0.latency_p50_us > 0
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        for i in range(5):
+            c.get(f"t:{i}")
+    time.sleep(0.05)
+    s1 = topmod.sample_node(node)
+    frame = topmod.render_table({node: s0}, {node: s1})
+    assert node in frame and "UP" in frame and "KEYS" in frame
+    # A dead node renders a DOWN row instead of raising.
+    dead = "127.0.0.1:1"
+    s_dead = topmod.sample_node(dead, timeout=0.2)
+    frame2 = topmod.render_table({}, {dead: s_dead})
+    assert "DOWN" in frame2
+
+
+def test_top_once_cli(server):
+    from merklekv_tpu.obs.top import main as top_main
+
+    _, srv = server
+    import io
+    import contextlib
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = top_main([
+            "--nodes", f"127.0.0.1:{srv.port}", "--interval", "0.1",
+            "--once",
+        ])
+    assert rc == 0
+    assert f"127.0.0.1:{srv.port}" in out.getvalue()
