@@ -27,7 +27,11 @@ The remaining BASELINE.json configs print one JSON line each on STDERR
   - op_latency_us: client-observed SET/GET p50/p99 against the embedded
     native server over localhost TCP;
   - sync_wire_bytes_1key: anti-entropy transfer cost for 1 divergent key
-    (subtree-bisection walk vs paged hash scan, bytes + wall time).
+    (subtree-bisection walk vs paged hash scan, bytes + wall time);
+  - replicated_write_throughput: 2-node replication pipeline A/B — events/s
+    from ingest to converged device roots, batched envelope frames + native
+    batch apply vs per-event publish/apply, with the replicator.batch_size
+    histogram snapshot embedded in the record.
 
 Off-TPU the sizes shrink to smoke-test values so the script stays runnable
 in CI; the driver's real run happens on the chip.
@@ -339,6 +343,117 @@ def bench_sync_wire_bytes(n_keys: int) -> dict:
         eng_b.close()
 
 
+def bench_replicated_write_throughput(n_events: int) -> dict:
+    """Batched replication pipeline A/B (this PR's tentpole evidence).
+
+    Drives a 2-node in-process cluster (TcpBroker fabric) to sustained
+    write load on node A and measures events/second from first ingest to
+    CONVERGED state on node B — publisher -> wire frame -> batched apply ->
+    device-mirror root. Runs the same load twice: per-event mode
+    (batch_max_events=1: one publish + one decode + one FFI apply per
+    event, the pre-batching wire format) vs batched mode (coalesced
+    envelope frames, native mkv_engine_apply_batch, one mirror staging
+    call per frame). Convergence is checked on ENGINE Merkle roots and
+    then the DEVICE-mirror roots of both sides, all four bit-identical.
+    The JSON record embeds the replicator.batch_size histogram snapshot
+    (log2 buckets, bound i = 2^i events) and the coalesced counter."""
+    import uuid as _uuid
+
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.cluster.transport import TcpBroker
+    from merklekv_tpu.config import Config
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+    from merklekv_tpu.utils.tracing import get_metrics
+
+    def run(batch_max_events: int) -> float:
+        broker = TcpBroker()
+        topic = f"bench-{_uuid.uuid4().hex[:8]}"
+        nodes = []
+        try:
+            for name in ("bench-a", "bench-b"):
+                engine = NativeEngine("mem")
+                server = NativeServer(engine, "127.0.0.1", 0)
+                server.start()
+                cfg = Config()
+                cfg.replication.enabled = True
+                cfg.replication.mqtt_broker = broker.host
+                cfg.replication.mqtt_port = broker.port
+                cfg.replication.topic_prefix = topic
+                cfg.replication.client_id = name
+                cfg.replication.batch_max_events = batch_max_events
+                node = ClusterNode(cfg, engine, server)
+                node.start()
+                nodes.append((engine, server, node))
+            (eng_a, srv_a, node_a), (eng_b, _srv_b, node_b) = nodes
+            with MerkleKVClient("127.0.0.1", srv_a.port) as c:
+                t0 = time.perf_counter()
+                chunk = 100
+                for base in range(0, n_events, chunk):
+                    c.mset(
+                        {
+                            f"rt:{i:08d}": f"v-{i}"
+                            for i in range(base, min(base + chunk, n_events))
+                        }
+                    )
+                deadline = time.time() + 120
+                root_a = root_b = None
+                while time.time() < deadline:
+                    root_a, root_b = eng_a.merkle_root(), eng_b.merkle_root()
+                    if root_a is not None and root_a == root_b:
+                        break
+                    time.sleep(0.002)
+                dt = time.perf_counter() - t0
+            if root_a is None or root_a != root_b:
+                raise AssertionError("replicas never converged")
+            # Device-mirror roots: warm lazily on first use, then must be
+            # bit-identical to each other AND to the engine root.
+            deadline = time.time() + 120
+            dev_a = dev_b = None
+            while time.time() < deadline:
+                dev_a = node_a.device_root_hex()
+                dev_b = node_b.device_root_hex()
+                if dev_a is not None and dev_b is not None:
+                    break
+                time.sleep(0.02)
+            if not (dev_a == dev_b == root_a.hex()):
+                raise AssertionError(
+                    f"device roots diverged: {dev_a} {dev_b} {root_a.hex()}"
+                )
+            return n_events / dt
+        finally:
+            for engine, server, node in reversed(nodes):
+                node.stop()
+                server.close()
+                engine.close()
+            broker.close()
+
+    per_event_rate = run(1)
+    batched_rate = run(512)
+    m = get_metrics()
+    hist = m.histogram("replicator.batch_size").snapshot()
+    counters = m.snapshot()["counters"]
+    return {
+        "metric": "replicated_write_throughput",
+        "value": round(batched_rate, 1),
+        "unit": "events/s (batched, ingest->converged device roots)",
+        "n_events": n_events,
+        "batched_events_per_s": round(batched_rate, 1),
+        "per_event_events_per_s": round(per_event_rate, 1),
+        "speedup_x": round(batched_rate / max(per_event_rate, 1e-9), 2),
+        "coalesced": counters.get("replicator.coalesced", 0),
+        "publish_errors": counters.get("replicator.publish_errors", 0),
+        # Log2 size buckets: bucket i counts frames of <= 2^i events.
+        "batch_size_hist": {
+            "bucket_le_2toi_events": hist["counts"],
+            "frames": hist["count"],
+            "events": int(round(hist["sum"] * 1e6)),
+        },
+        "target": 5.0,
+        "target_met": batched_rate / max(per_event_rate, 1e-9) >= 5.0,
+    }
+
+
 def bench_metrics_overhead(n_ops: int, rounds: int = 5) -> dict:
     """Metrics-plane cost on the SET hot path.
 
@@ -575,6 +690,15 @@ def _run(backend: str) -> None:
         )
     except Exception as e:
         print(f"# sync_wire_bytes bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(
+            bench_replicated_write_throughput(
+                n_events=50_000 if on_tpu else 16_000
+            )
+        )
+    except Exception as e:
+        print(f"# replicated_write_throughput bench failed: {e!r}",
+              file=sys.stderr)
 
     # Every emitted record carries the run's metrics snapshot (counters +
     # span aggregates) so a BENCH_*.json trajectory shows what the run

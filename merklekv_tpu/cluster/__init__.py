@@ -17,10 +17,13 @@ Host-side subsystems around the native server and the TPU Merkle data plane:
 from merklekv_tpu.cluster.change_event import (
     ChangeEvent,
     OpKind,
+    coalesce_events,
     decode_any,
     decode_cbor,
     decode_binary,
+    decode_events,
     decode_json,
+    encode_batch_cbor,
     encode_cbor,
     encode_binary,
     encode_json,
@@ -31,10 +34,13 @@ __all__ = [
     "ChangeEvent",
     "OpKind",
     "LWWApplier",
+    "coalesce_events",
     "decode_any",
     "decode_cbor",
     "decode_binary",
+    "decode_events",
     "decode_json",
+    "encode_batch_cbor",
     "encode_cbor",
     "encode_binary",
     "encode_json",

@@ -45,6 +45,14 @@ class LWWApplier:
                                     to the in-memory map, so a restarted
                                     applier (empty maps) still rejects stale
                                     events against repaired/persisted state.
+      apply_batch_fn(ops) -> flags — run a whole frame of LWW-conditional
+                                    ops (``(key, value|None-for-del, ts)``)
+                                    in ONE engine call, returning one
+                                    applied flag per op. When wired,
+                                    :meth:`apply_batch` crosses the FFI
+                                    once per frame instead of once per
+                                    event, and the engine (not a host-side
+                                    ts floor) is the LWW authority.
     """
 
     def __init__(
@@ -55,12 +63,16 @@ class LWWApplier:
         set_ts_fn: Optional[Callable[[bytes, bytes, int], None]] = None,
         del_ts_fn: Optional[Callable[[bytes, int], None]] = None,
         store_ts_fn: Optional[Callable[[bytes], int]] = None,
+        apply_batch_fn: Optional[
+            Callable[[list[tuple[bytes, Optional[bytes], int]]], list[bool]]
+        ] = None,
     ) -> None:
         self._set = set_fn
         self._set_ts = set_ts_fn
         self._del = del_fn
         self._del_ts = del_ts_fn
         self._store_ts = store_ts_fn
+        self._apply_batch_fn = apply_batch_fn
         self._seen: OrderedDict[bytes, None] = OrderedDict()
         self._max_seen = max_seen
         self._last_ts: dict[str, int] = {}
@@ -133,6 +145,59 @@ class LWWApplier:
         self._last_op_id[ev.key] = ev.op_id
         self.applied += 1
         return True
+
+    def apply_batch(self, events: list[ChangeEvent]) -> list[ChangeEvent]:
+        """Apply one decoded wire frame; returns the events that changed
+        state (in frame order).
+
+        With ``apply_batch_fn`` wired (the native engine's batched
+        LWW-conditional call), all surviving ops cross the FFI ONCE —
+        dedupe and the cheap in-memory ts floor still prefilter here, but
+        the engine's conditional verbs are the LWW authority (a per-event
+        ``store_ts_fn`` consult would reintroduce two FFI calls per event,
+        and the engine rejects stale timestamps anyway). Without it, falls
+        back to per-event :meth:`apply` (plain-callable test doubles).
+        """
+        if self._apply_batch_fn is None:
+            return [ev for ev in events if self.apply(ev)]
+        pending: list[ChangeEvent] = []
+        ops: list[tuple[bytes, Optional[bytes], int]] = []
+        batch_seen: set[bytes] = set()
+        for ev in events:
+            if ev.op_id in self._seen or ev.op_id in batch_seen:
+                # _seen is only updated after the engine call, so a
+                # duplicated op INSIDE one frame needs the batch-local set.
+                self.skipped_dup += 1
+                continue
+            batch_seen.add(ev.op_id)
+            if ev.ts < self._last_ts.get(ev.key, 0):
+                self._remember(ev.op_id)
+                self.skipped_lww += 1
+                continue
+            key = ev.key.encode("utf-8", "surrogateescape")
+            if ev.op is OpKind.DEL:
+                pending.append(ev)
+                ops.append((key, None, ev.ts))
+            elif ev.val is not None:
+                pending.append(ev)
+                ops.append((key, ev.val, ev.ts))
+            else:  # SET-like op with no value: nothing to install
+                self._remember(ev.op_id)
+                self.skipped_lww += 1
+        if not ops:
+            return []
+        flags = self._apply_batch_fn(ops)
+        applied: list[ChangeEvent] = []
+        for ev, flag in zip(pending, flags):
+            self._remember(ev.op_id)
+            if flag:
+                self._last_ts[ev.key] = ev.ts
+                self._last_op_id[ev.key] = ev.op_id
+                self.applied += 1
+                applied.append(ev)
+            else:
+                self.skipped_lww += 1
+        return applied
 
     def _remember(self, op_id: bytes) -> None:
         self._seen[op_id] = None

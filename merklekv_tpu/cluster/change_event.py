@@ -13,6 +13,17 @@ for debuggability. ``decode_any`` tries CBOR -> binary -> JSON. The CBOR
 encoder below emits standard definite-length RFC 8949 items (maps with text
 keys, uints, byte/text strings, null), so third-party CBOR tooling can read
 events off the wire.
+
+Batch framing: a drained replication batch travels as ONE versioned CBOR
+envelope ``{v, src, events: [...]}`` (``encode_batch_cbor``) instead of one
+publish per event — the publisher coalesces per key first
+(``coalesce_events``: every event carries its post-op value, so the last
+SET/DEL per key alone reproduces that key's final state). Receivers use
+``decode_events``, which accepts both the envelope and every legacy
+single-event format, so mixed-version clusters stay wire-compatible: an
+old publisher's single events keep applying here, while an old subscriber
+counts a new publisher's envelopes as decode errors and anti-entropy
+repairs what it missed (see docs/PROTOCOL.md "Replication framing").
 """
 
 from __future__ import annotations
@@ -29,13 +40,17 @@ from typing import Optional
 __all__ = [
     "OpKind",
     "ChangeEvent",
+    "BATCH_ENVELOPE_VERSION",
+    "coalesce_events",
     "encode_cbor",
     "decode_cbor",
+    "encode_batch_cbor",
     "encode_binary",
     "decode_binary",
     "encode_json",
     "decode_json",
     "decode_any",
+    "decode_events",
 ]
 
 
@@ -126,14 +141,19 @@ def _cbor_text_or_bytes(s: str) -> bytes:
 _CBOR_NULL = b"\xf6"
 
 
-def encode_cbor(ev: ChangeEvent) -> bytes:
+def _event_map_cbor(ev: ChangeEvent, include_src: bool = True) -> bytes:
+    """One event as a CBOR map. Inside a batch envelope ``src`` is carried
+    once on the envelope, so per-event maps omit it (include_src=False)."""
     pairs = [
         (b"\x61v", _cbor_uint(ev.v)),
         (b"\x62op", _cbor_text(ev.op.value)),
         (b"\x63key", _cbor_text_or_bytes(ev.key)),
         (b"\x63val", _CBOR_NULL if ev.val is None else _cbor_bytes(ev.val)),
         (b"\x62ts", _cbor_uint(ev.ts)),
-        (b"\x63src", _cbor_text_or_bytes(ev.src)),
+    ]
+    if include_src:
+        pairs.append((b"\x63src", _cbor_text_or_bytes(ev.src)))
+    pairs += [
         (b"\x65op_id", _cbor_bytes(ev.op_id)),
         (b"\x64prev", _CBOR_NULL if ev.prev is None else _cbor_bytes(ev.prev)),
         (b"\x63ttl", _CBOR_NULL if ev.ttl is None else _cbor_uint(ev.ttl)),
@@ -142,6 +162,47 @@ def encode_cbor(ev: ChangeEvent) -> bytes:
     for k, v in pairs:
         out += k + v
     return out
+
+
+def encode_cbor(ev: ChangeEvent) -> bytes:
+    return _event_map_cbor(ev)
+
+
+# ------------------------------------------------------------ batch frame
+
+# Version of the batch envelope FORMAT (distinct from the per-event v
+# field): receivers refuse unknown versions loudly instead of misapplying
+# half-understood frames.
+BATCH_ENVELOPE_VERSION = 1
+
+
+def coalesce_events(
+    events: list[ChangeEvent],
+) -> tuple[list[ChangeEvent], int]:
+    """Per-key coalescing for one wire frame: a later SET/DEL on a key
+    supersedes every earlier op on it — safe because events carry POST-OP
+    values, so the last event alone reproduces the key's final state (and
+    receivers are per-key LWW anyway). Returns (kept events in stable
+    order, number coalesced away)."""
+    last: dict[str, int] = {}
+    for i, ev in enumerate(events):
+        last[ev.key] = i
+    kept = [ev for i, ev in enumerate(events) if last[ev.key] == i]
+    return kept, len(events) - len(kept)
+
+
+def encode_batch_cbor(events: list[ChangeEvent], src: str) -> bytes:
+    """Batch envelope ``{v, src, events: [...]}``: one wire frame for a
+    whole drained batch. ``src`` rides on the envelope once; per-event maps
+    omit it (the decoder reinstates it)."""
+    body = bytearray(_cbor_head(4, len(events)))
+    for ev in events:
+        body += _event_map_cbor(ev, include_src=False)
+    out = bytearray(_cbor_head(5, 3))
+    out += b"\x61v" + _cbor_uint(BATCH_ENVELOPE_VERSION)
+    out += b"\x63src" + _cbor_text_or_bytes(src)
+    out += b"\x66events" + bytes(body)
+    return bytes(out)
 
 
 class _CborReader:
@@ -222,12 +283,18 @@ def _as_key_str(x) -> str:
 
 
 def _from_map(m: dict) -> ChangeEvent:
+    val = m.get("val")
+    if val is not None and not isinstance(val, (bytes, bytearray)):
+        # A corrupt frame can decode "val" into a non-bytes CBOR item;
+        # letting it through would blow up deep in the applier's FFI
+        # instead of at the decode boundary where errors are counted.
+        raise ValueError(f"event val must be bytes, got {type(val).__name__}")
     try:
         return ChangeEvent(
             v=int(m["v"]),
             op=OpKind(m["op"]),
             key=_as_key_str(m["key"]),
-            val=m["val"],
+            val=None if val is None else bytes(val),
             ts=int(m["ts"]),
             src=_as_key_str(m["src"]),
             op_id=bytes(m["op_id"]),
@@ -337,3 +404,39 @@ def decode_any(data: bytes) -> ChangeEvent:
         except Exception:
             continue
     raise ValueError("undecodable change event")
+
+
+def _events_from_envelope(m: dict) -> list[ChangeEvent]:
+    v = m.get("v")
+    if v != BATCH_ENVELOPE_VERSION:
+        raise ValueError(f"unsupported batch envelope version {v!r}")
+    evs = m.get("events")
+    if not isinstance(evs, list):
+        raise ValueError("batch envelope 'events' must be an array")
+    src = _as_key_str(m.get("src", ""))
+    out = []
+    for em in evs:
+        if not isinstance(em, dict):
+            raise ValueError("batch envelope event must be a map")
+        if "src" not in em:
+            em = dict(em)
+            em["src"] = src
+        out.append(_from_map(em))
+    return out
+
+
+def decode_events(data: bytes) -> list[ChangeEvent]:
+    """Replication inbound decode: a batch envelope yields its events; any
+    legacy single-event payload (CBOR/binary/JSON) yields a one-event list
+    — old publishers stay wire-compatible with batching subscribers.
+    Raises ValueError for undecodable frames AND for envelopes of an
+    unknown version or malformed shape (a half-understood frame must be
+    counted and dropped whole, never partially applied)."""
+    m = None
+    try:
+        m = _CborReader(data).item()
+    except Exception:
+        pass
+    if isinstance(m, dict) and "events" in m:
+        return _events_from_envelope(m)
+    return [decode_any(data)]

@@ -156,14 +156,23 @@ class DeviceTreeMirror:
             self._synced_version = self._engine.version()
 
     def apply_one(self, key: bytes, value: Optional[bytes]) -> None:
-        """Remote writes, applied inline by the LWW applier."""
+        """One remote write (anti-entropy repair hook)."""
+        self.apply_batch([(key, value)])
+
+    def apply_batch(self, pairs: list[tuple[bytes, Optional[bytes]]]) -> None:
+        """Remote writes from one decoded replication frame: ONE lock
+        acquisition and ONE device-state staging call for the whole frame
+        (per-key applies paid both per event — at sustained remote write
+        rates the lock/stage overhead, not the device math, dominated)."""
+        if not pairs:
+            return
         with self._mu:
             if self._closed:
                 return
             if self._state is None:
-                self._note_pending([key])
+                self._note_pending(k for k, _ in pairs)
                 return
-            self._state.apply([(key, value)])
+            self._state.apply(pairs)
             self._synced_version = self._engine.version()
 
     def _note_pending(self, keys) -> None:

@@ -177,6 +177,8 @@ class ClusterNode:
                         storage.record_events if storage is not None else None
                     ),
                     storage=storage,
+                    batch_max_events=self._cfg.replication.batch_max_events,
+                    batch_max_bytes=self._cfg.replication.batch_max_bytes,
                 )
                 self._replicator.start()
             except Exception as e:
@@ -390,14 +392,13 @@ class ClusterNode:
             lines.append(f"{name}:{snap['counters'][name]}")
         # Span aggregates (integers only — the parsers treat values as
         # numeric text): count, total, and bucket-derived percentiles per
-        # span name. total_us is the canonical total; total_ms is kept one
-        # release for old readers and DEPRECATED (sub-millisecond spans
-        # truncate to 0 there — docs/PROTOCOL.md "METRICS").
+        # span name. total_us is the canonical total; the deprecated
+        # total_ms field (sub-millisecond spans truncated to 0) finished
+        # its one-release window and is gone — docs/PROTOCOL.md "METRICS".
         for name in sorted(snap["spans"]):
             sp = snap["spans"][name]
             lines.append(f"span.{name}.count:{sp['count']}")
             lines.append(f"span.{name}.total_us:{int(sp['total_s'] * 1e6)}")
-            lines.append(f"span.{name}.total_ms:{int(sp['total_s'] * 1e3)}")
             hist = snap["histograms"].get(f"span.{name}")
             if hist and hist["count"]:
                 h = metrics.histogram(f"span.{name}")

@@ -16,12 +16,21 @@ Differences by design:
   - applied remote writes go straight to the shared native engine, so they
     do NOT re-enter the server's event queue — no echo loop;
   - the drained batches also feed the TPU incremental Merkle path.
+
+The pipeline is batch-native end to end: a drained batch is coalesced per
+key and published as ONE versioned envelope frame (change_event.py,
+``[replication] batch_max_events`` / ``batch_max_bytes``), the drain thread
+parks on the native queue's notify instead of interval polling, and an
+inbound frame runs its surviving ops through ONE native
+``mkv_engine_apply_batch`` crossing, ONE device-mirror staging call, and
+ONE grouped WAL append. ``batch_max_events <= 1`` publishes legacy
+single-event payloads (mixed-version compat mode; also the per-event
+baseline ``bench.py replicated_write_throughput`` A/Bs against).
 """
 
 from __future__ import annotations
 
 import threading
-import time
 import uuid
 from typing import Callable, Optional
 
@@ -29,7 +38,9 @@ from merklekv_tpu.cluster.applier import LWWApplier
 from merklekv_tpu.cluster.change_event import (
     ChangeEvent,
     OpKind,
-    decode_any,
+    coalesce_events,
+    decode_events,
+    encode_batch_cbor,
     encode_cbor,
 )
 from merklekv_tpu.cluster.retry import REPLICATOR_PUBLISH, RetryPolicy
@@ -62,6 +73,13 @@ _OP_MAP = {
 
 
 class Replicator:
+    # Drain-thread park bound: the notify wakes it on the first staged
+    # write, so this only caps how long a stop request can go unnoticed.
+    IDLE_WAIT_MS = 200
+    # Conservative per-event envelope overhead (op_id + field heads + ts)
+    # used by the batch_max_bytes frame splitter.
+    _EVENT_WIRE_OVERHEAD = 64
+
     def __init__(
         self,
         engine: NativeEngine,
@@ -69,11 +87,12 @@ class Replicator:
         transport: Transport,
         topic_prefix: str = "merkle_kv",
         node_id: str = "",
-        drain_interval: float = 0.005,
         batch_listener: Optional[Callable[[list[ChangeEvent]], None]] = None,
         mirror=None,  # Optional[DeviceTreeMirror]
         storage=None,  # Optional[DurableStore]: journals applied remote writes
         retry: Optional[RetryPolicy] = None,
+        batch_max_events: int = 512,
+        batch_max_bytes: int = 1 << 20,
     ) -> None:
         self._engine = engine
         self._server = server
@@ -81,62 +100,40 @@ class Replicator:
         self._transport = transport
         self._topic = f"{topic_prefix}/events"
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:12]}"
-        self._drain_interval = drain_interval
         self._batch_listener = batch_listener
         self._mirror = mirror
+        # <= 1 selects legacy per-event publishing: the wire format an
+        # un-batched (older) peer understands, and the per-event baseline
+        # the throughput bench A/Bs against.
+        self._batch_max_events = max(0, batch_max_events)
+        self._batch_max_bytes = max(1024, batch_max_bytes)
         # Publish retry under the shared cluster policy: one near-immediate
         # retry for a transient transport hiccup, then drop and count
         # (QoS-0 by design; anti-entropy repairs the residue).
         self._retry = retry if retry is not None else REPLICATOR_PUBLISH
 
         # Remote applies install the EVENT's timestamp through the engine's
-        # LWW-conditional ops (set_if_newer / del_if_newer), so replication
-        # LWW, anti-entropy LWW, and the store's persisted ordering are ONE
-        # ordering — a replayed event older than a sync-repaired value is
-        # rejected at the shard lock, not re-installed. Applies also bypass
-        # the server's event queue (no echo loop), so the device mirror is
-        # fed inline here — only when the op actually changed state.
-        def _set_ts(k: bytes, v: bytes, ts: int) -> bool:
-            applied = engine.set_if_newer(k, v, ts)
-            if applied:
-                if mirror is not None:
-                    mirror.apply_one(k, v)
-                if storage is not None:
-                    storage.record_set(k, v, ts)
-            return applied
-
-        def _del(k: bytes) -> None:
-            if engine.delete(k):
-                if mirror is not None:
-                    mirror.apply_one(k, None)
-                if storage is not None:
-                    # delete() stamped the tombstone "now" inside the
-                    # engine; journal that exact ts for identical replay.
-                    ts = engine.tombstone_ts(k)
-                    if ts is not None:
-                        storage.record_delete(k, ts)
-
-        def _del_ts(k: bytes, ts: int) -> bool:
-            applied = engine.delete_if_newer(k, ts)
-            if applied:
-                if mirror is not None:
-                    mirror.apply_one(k, None)
-                if storage is not None:
-                    storage.record_delete(k, ts)
-            return applied
-
+        # LWW-conditional ops, so replication LWW, anti-entropy LWW, and the
+        # store's persisted ordering are ONE ordering — a replayed event
+        # older than a sync-repaired value is rejected at the shard lock,
+        # not re-installed. A whole inbound frame crosses the FFI once
+        # (apply_batch_fn); the applied residue feeds the device mirror and
+        # the WAL as single batch calls in _on_message (applies bypass the
+        # server's event queue — no echo loop — so this is the mirror's
+        # only view of remote writes).
         def _store_ts(k: bytes) -> int:
-            # The store's LWW floor: live entry ts or tombstone ts. Keeps a
-            # restarted applier (empty in-memory maps) from resurrecting
-            # state that anti-entropy or a prior run already superseded.
+            # LWW floor for the per-event fallback path: live entry ts or
+            # tombstone ts, so a restarted applier (empty in-memory maps)
+            # still rejects stale events against persisted state.
             return max(engine.get_ts(k) or 0, engine.tombstone_ts(k) or 0)
 
         self._applier = LWWApplier(
             engine.set,
-            _del,
-            set_ts_fn=_set_ts,
-            del_ts_fn=_del_ts,
+            lambda k: engine.delete(k),
+            set_ts_fn=lambda k, v, ts: engine.set_if_newer(k, v, ts),
+            del_ts_fn=lambda k, ts: engine.delete_if_newer(k, ts),
             store_ts_fn=_store_ts,
+            apply_batch_fn=engine.apply_batch,
         )
         self._applier_mu = threading.Lock()
         # Spans drain..mirror-apply: a flush() must not return while another
@@ -149,6 +146,7 @@ class Replicator:
         self.received = 0
         self.decode_errors = 0
         self.publish_errors = 0
+        self.coalesced = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -191,23 +189,12 @@ class Replicator:
                     # divergent root forever; invalidate so HASH falls back
                     # to the native path until a re-warm succeeds.
                     self._mirror.invalidate()
-            published = 0
-            for ev in events:
-                # TRUNCATE stays local: it only invalidates device mirrors.
-                if ev.op is OpKind.TRUNCATE:
-                    continue
-                payload = encode_cbor(ev)
-                try:
-                    self._retry.run(
-                        lambda: self._transport.publish(self._topic, payload),
-                        retry_on=(Exception,),
-                        should_stop=self._stop.is_set,
-                    )
-                    published += 1
-                except Exception:
-                    # QoS-0 fabric: drop and count; anti-entropy repairs.
-                    self.publish_errors += 1
-                    get_metrics().inc("replicator.publish_errors")
+            # TRUNCATE stays local: it only invalidates device mirrors.
+            publishable = [ev for ev in events if ev.op is not OpKind.TRUNCATE]
+            if self._batch_max_events <= 1:
+                published = self._publish_per_event(publishable)
+            else:
+                published = self._publish_frames(publishable)
             self.published += published
             if published:
                 # Registry mirror of the instance counters so METRICS (and
@@ -221,10 +208,81 @@ class Replicator:
                     pass
             return len(events)
 
+    def _publish(self, payload: bytes) -> bool:
+        try:
+            self._retry.run(
+                lambda: self._transport.publish(self._topic, payload),
+                retry_on=(Exception,),
+                should_stop=self._stop.is_set,
+            )
+            return True
+        except Exception:
+            # QoS-0 fabric: drop and count; anti-entropy repairs.
+            self.publish_errors += 1
+            get_metrics().inc("replicator.publish_errors")
+            return False
+
+    def _publish_per_event(self, events: list[ChangeEvent]) -> int:
+        """Legacy mode (batch_max_events <= 1): one single-event payload per
+        write — the format un-batched peers decode, and the per-event
+        baseline the throughput bench measures against."""
+        published = 0
+        for ev in events:
+            if self._publish(encode_cbor(ev)):
+                published += 1
+        return published
+
+    def _publish_frames(self, events: list[ChangeEvent]) -> int:
+        """Coalesce per key, split under the [replication] frame caps, and
+        publish each frame as ONE envelope. A failed frame drops its whole
+        event group (QoS-0 granularity is now the frame — documented in
+        docs/FAULT_MODEL.md; anti-entropy repairs the residue)."""
+        kept, dropped = coalesce_events(events)
+        if dropped:
+            self.coalesced += dropped
+            get_metrics().inc("replicator.coalesced", dropped)
+        published = 0
+        metrics = get_metrics()
+        for frame in self._split_frames(kept):
+            metrics.observe_size("replicator.batch_size", len(frame))
+            if self._publish(encode_batch_cbor(frame, self.node_id)):
+                published += len(frame)
+        return published
+
+    def _split_frames(
+        self, events: list[ChangeEvent]
+    ) -> list[list[ChangeEvent]]:
+        frames: list[list[ChangeEvent]] = []
+        cur: list[ChangeEvent] = []
+        cur_bytes = 0
+        for ev in events:
+            # Key sized in encoded BYTES (a CJK or surrogateescape raw key
+            # is up to ~4x its character count on the wire).
+            size = (
+                len(ev.key.encode("utf-8", "surrogateescape"))
+                + len(ev.val or b"")
+                + self._EVENT_WIRE_OVERHEAD
+            )
+            if cur and (
+                len(cur) >= self._batch_max_events
+                or cur_bytes + size > self._batch_max_bytes
+            ):
+                frames.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(ev)
+            cur_bytes += size
+        if cur:
+            frames.append(cur)
+        return frames
+
     def _drain_loop(self) -> None:
         while not self._stop.is_set():
             if self.flush() == 0:
-                time.sleep(self._drain_interval)
+                # Park on the native queue's notify: the first staged write
+                # wakes the drain immediately (no 5 ms poll floor on idle
+                # latency, no idle wakeup CPU); the timeout only bounds how
+                # long a stop request waits.
+                self._server.wait_events(self.IDLE_WAIT_MS)
 
     def _to_event(self, raw: ChangeEventRaw) -> ChangeEvent:
         return ChangeEvent(
@@ -238,19 +296,43 @@ class Replicator:
     # -- inbound ------------------------------------------------------------
     def _on_message(self, topic: str, payload: bytes) -> None:
         try:
-            ev = decode_any(payload)
+            events = decode_events(payload)
         except ValueError:
-            # Malformed messages are tolerated, like the reference's decoder
-            # fallthrough (replication.rs:150-157).
+            # Malformed messages (and unknown envelope versions) are
+            # tolerated, like the reference's decoder fallthrough
+            # (replication.rs:150-157) — counted, never applied partially.
             self.decode_errors += 1
             get_metrics().inc("replicator.decode_errors")
             return
-        if ev.src == self.node_id:
-            return  # loop prevention
-        self.received += 1
-        get_metrics().inc("replicator.received")
+        events = [ev for ev in events if ev.src != self.node_id]  # no echo
+        if not events:
+            return
+        self.received += len(events)
+        get_metrics().inc("replicator.received", len(events))
         with self._applier_mu:
-            self._applier.apply(ev)
+            applied = self._applier.apply_batch(events)
+            if not applied:
+                return
+            # Batch fan-out of the applied residue, still under the applier
+            # lock so concurrent frames reach the mirror in engine-apply
+            # order: ONE mirror staging call and ONE grouped WAL append per
+            # frame (the exact LWW ts rides with each op).
+            pairs = [
+                (
+                    ev.key.encode("utf-8", "surrogateescape"),
+                    None if ev.op is OpKind.DEL else ev.val,
+                )
+                for ev in applied
+            ]
+            if self._mirror is not None:
+                self._mirror.apply_batch(pairs)
+            if self._storage is not None:
+                self._storage.record_applied(
+                    [
+                        (key, val, ev.ts)
+                        for (key, val), ev in zip(pairs, applied)
+                    ]
+                )
 
     # -- introspection -------------------------------------------------------
     @property

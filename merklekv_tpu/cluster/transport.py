@@ -116,6 +116,24 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 # total is peer-controlled and must not size a buffer unchecked.
 MAX_FRAME = 16 << 20
 
+# Socket buffer sizing for every fabric socket (broker and clients). The
+# kernel default (~208 KiB) holds only ~4K single-event frames; a
+# replication burst that outruns the fan-out for a moment fills it, and a
+# full receive buffer degrades loopback TCP into a persist-timer
+# stop-and-go (~10 frames/s observed on a 4.x kernel) that outlives the
+# burst by minutes. 4 MiB absorbs ~10^5 in-flight events, which keeps even
+# the per-event compat mode (batch_max_events <= 1) out of that regime;
+# the kernel silently caps the request where limits are lower.
+SOCK_BUF_BYTES = 1 << 22
+
+
+def _enlarge_sock_buffers(sock: socket.socket) -> None:
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, SOCK_BUF_BYTES)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, SOCK_BUF_BYTES)
+    except OSError:
+        pass  # best effort: a capped buffer only lowers the burst ceiling
+
 
 def _read_frame(sock: socket.socket) -> Optional[tuple[str, bytes]]:
     head = _read_exact(sock, 6)
@@ -143,6 +161,8 @@ class TcpBroker:
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Before listen(): accepted sockets inherit the enlarged buffers.
+        _enlarge_sock_buffers(self._listener)
         self._listener.bind((host, port))
         self._listener.listen(128)
         self.host, self.port = self._listener.getsockname()
@@ -429,6 +449,7 @@ class TcpTransport:
             raise ConnectionRefusedError("self-connect (broker down)")
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _enlarge_sock_buffers(sock)
         _enable_tcp_keepalive(sock)
         return sock
 

@@ -32,6 +32,7 @@ from merklekv_tpu.cluster.transport import (
     _dead_socket,
     _drain_outbox,
     _enable_tcp_keepalive,
+    _enlarge_sock_buffers,
     _heal_link,
     _publish_or_queue,
 )
@@ -207,6 +208,7 @@ class MqttTransport:
             sock.close()
             raise ConnectionRefusedError("self-connect (broker down)")
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _enlarge_sock_buffers(sock)  # burst headroom (transport.py note)
         # Kernel-level liveness too: with keepalive=0 (app-level keepalive
         # disabled per spec) this is the ONLY silent-partition detection.
         _enable_tcp_keepalive(sock)
@@ -433,6 +435,7 @@ class MqttBroker:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _enlarge_sock_buffers(sock)
             with self._mu:
                 cid = self._next
                 self._next += 1

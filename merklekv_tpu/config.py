@@ -133,6 +133,14 @@ class ReplicationConfig:
     username: str = ""
     password: str = ""
     peer_list: list[str] = field(default_factory=list)
+    # Outbound frame caps: a drained batch is coalesced per key and
+    # published as envelope frames of at most batch_max_events events /
+    # ~batch_max_bytes payload each. <= 1 disables batching — every event
+    # goes out as a legacy single-event payload (the format peers that
+    # predate the batch envelope decode; also the per-event baseline the
+    # replicated_write_throughput bench A/Bs against).
+    batch_max_events: int = 512
+    batch_max_bytes: int = 1 << 20
 
     def resolve_env(self) -> None:
         self.client_id = os.environ.get("CLIENT_ID", self.client_id)
@@ -272,6 +280,15 @@ class Config:
             cfg.replication.mqtt_port = int(rep["mqtt_port"])
         if "peer_list" in rep:
             cfg.replication.peer_list = [str(p) for p in rep["peer_list"]]
+        if "batch_max_events" in rep:
+            cfg.replication.batch_max_events = int(rep["batch_max_events"])
+        if "batch_max_bytes" in rep:
+            cfg.replication.batch_max_bytes = int(rep["batch_max_bytes"])
+        if cfg.replication.batch_max_bytes < 1024:
+            raise ValueError(
+                "[replication] batch_max_bytes must be >= 1024, got "
+                f"{cfg.replication.batch_max_bytes}"
+            )
         if "enabled" in ae:
             cfg.anti_entropy.enabled = bool(ae["enabled"])
         if "interval_seconds" in ae:

@@ -143,6 +143,46 @@ int mkv_engine_del_if_newer(void* h, const char* key, int klen,
              : 0;
 }
 
+// Batched LWW-conditional apply: one FFI crossing for a whole replication
+// frame. Input buffer: u32 count, then per op u8 kind (0=SET 1=DEL),
+// u64 ts, u32 klen, key, u32 vlen, value (vlen always present; 0 for DEL).
+// Output: count bytes of applied flags (same index order), free with
+// mkv_free. Returns the op count, or -1 on a malformed buffer.
+int mkv_engine_apply_batch(void* h, const char* buf, long long buf_len,
+                           char** out_flags) {
+  const size_t len = buf_len < 0 ? 0 : size_t(buf_len);
+  size_t off = 0;
+  auto take = [&](void* dst, size_t n) {
+    if (off + n > len) return false;
+    std::memcpy(dst, buf + off, n);
+    off += n;
+    return true;
+  };
+  uint32_t count = 0;
+  if (!take(&count, 4)) return -1;
+  std::vector<mkv::BatchOp> ops;
+  ops.reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    uint8_t kind;
+    uint64_t ts;
+    uint32_t klen, vlen;
+    if (!take(&kind, 1) || !take(&ts, 8) || !take(&klen, 4)) return -1;
+    if (off + klen > len) return -1;
+    std::string key(buf + off, klen);
+    off += klen;
+    if (!take(&vlen, 4) || off + vlen > len) return -1;
+    std::string value(buf + off, vlen);
+    off += vlen;
+    ops.push_back(mkv::BatchOp{kind == 1, ts, std::move(key),
+                               std::move(value)});
+  }
+  auto flags = static_cast<Engine*>(h)->apply_batch(ops);
+  char* p = static_cast<char*>(std::malloc(flags.size() ? flags.size() : 1));
+  if (p && !flags.empty()) std::memcpy(p, flags.data(), flags.size());
+  *out_flags = p;
+  return int(flags.size());
+}
+
 // Returns 1 and fills *out_ts with the key's tombstone timestamp, else 0.
 int mkv_engine_tombstone_ts(void* h, const char* key, int klen,
                             unsigned long long* out_ts) {
@@ -437,6 +477,15 @@ int mkv_server_drain_events(void* h, int max_events, char** out,
 
 long long mkv_server_events_dropped(void* h) {
   return (long long)static_cast<ServerHandle*>(h)->server->events().dropped();
+}
+
+// Park until the event queue is non-empty (or timeout_ms). Returns 1 when
+// events are pending — the drain thread's event-driven wait.
+int mkv_server_wait_events(void* h, int timeout_ms) {
+  return static_cast<ServerHandle*>(h)->server->events().wait_nonempty(
+             timeout_ms)
+             ? 1
+             : 0;
 }
 
 // Stats text exactly as the STATS command body (for the control plane).

@@ -8,6 +8,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <cerrno>
 #include <cstdio>
 #include <cstdlib>
@@ -194,6 +195,11 @@ bool MemEngine::set_if_newer(const std::string& key, const std::string& value,
                              uint64_t ts) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
+  return set_if_newer_locked(s, key, value, ts);
+}
+
+bool MemEngine::set_if_newer_locked(Shard& s, const std::string& key,
+                                    const std::string& value, uint64_t ts) {
   auto it = s.map.find(key);
   if (it != s.map.end()) {
     if (ts < it->second.ts) return false;
@@ -232,6 +238,11 @@ bool MemEngine::set_if_newer(const std::string& key, const std::string& value,
 bool MemEngine::del_if_newer(const std::string& key, uint64_t ts) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
+  return del_if_newer_locked(s, key, ts);
+}
+
+bool MemEngine::del_if_newer_locked(Shard& s, const std::string& key,
+                                    uint64_t ts) {
   auto it = s.map.find(key);
   if (it != s.map.end()) {
     if (ts <= it->second.ts) return false;  // tie: value wins
@@ -247,6 +258,31 @@ bool MemEngine::del_if_newer(const std::string& key, uint64_t ts) {
   bool advanced = note_tomb(s, key, ts);
   if (advanced) bump_version();
   return advanced;
+}
+
+std::vector<uint8_t> MemEngine::apply_batch(const std::vector<BatchOp>& ops) {
+  std::vector<uint8_t> out(ops.size(), 0);
+  // Group op indices per shard, preserving the frame's relative order
+  // within each shard (per-key ordering only needs intra-shard order —
+  // one key always hashes to one shard). One unique_lock per touched
+  // shard then serves the whole group.
+  std::array<std::vector<size_t>, kShards> by_shard;
+  for (size_t i = 0; i < ops.size(); ++i) {
+    by_shard[shard_index(ops[i].key)].push_back(i);
+  }
+  for (size_t si = 0; si < kShards; ++si) {
+    if (by_shard[si].empty()) continue;
+    Shard& s = shards_[si];
+    std::unique_lock lk(s.mu);
+    for (size_t i : by_shard[si]) {
+      const BatchOp& op = ops[i];
+      out[i] = op.is_del ? (del_if_newer_locked(s, op.key, op.ts) ? 1 : 0)
+                         : (set_if_newer_locked(s, op.key, op.value, op.ts)
+                                ? 1
+                                : 0);
+    }
+  }
+  return out;
 }
 
 std::optional<uint64_t> MemEngine::tombstone_ts(const std::string& key) {

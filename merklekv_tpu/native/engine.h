@@ -33,6 +33,16 @@ struct Result {
   static Result Err(std::string e) { return Result{false, {}, std::move(e)}; }
 };
 
+// One op of a replication-apply batch: an LWW-conditional install
+// (set_if_newer semantics) or deletion (del_if_newer semantics) carrying
+// the event's exact timestamp.
+struct BatchOp {
+  bool is_del = false;
+  uint64_t ts = 0;
+  std::string key;
+  std::string value;  // empty for deletions
+};
+
 class Engine {
  public:
   virtual ~Engine() = default;
@@ -76,6 +86,25 @@ class Engine {
   virtual bool set_if_newer(const std::string& key, const std::string& value,
                             uint64_t ts) = 0;
   virtual bool del_if_newer(const std::string& key, uint64_t ts) = 0;
+  // Apply a whole replication frame in one call: per-op set_if_newer /
+  // del_if_newer semantics, returning one applied flag per op (same index).
+  // The point is the FFI batching — k remote ops used to cost k Python->C
+  // crossings; a frame is now ONE. The base implementation loops the
+  // conditional verbs (correct for any engine, including LogEngine's
+  // journaled variants); MemEngine overrides with per-shard lock grouping
+  // so a frame also pays one lock acquisition per touched shard instead of
+  // one per op. Ops on the same key must keep their relative order.
+  virtual std::vector<uint8_t> apply_batch(const std::vector<BatchOp>& ops) {
+    std::vector<uint8_t> out(ops.size(), 0);
+    for (size_t i = 0; i < ops.size(); ++i) {
+      out[i] = ops[i].is_del ? (del_if_newer(ops[i].key, ops[i].ts) ? 1 : 0)
+                             : (set_if_newer(ops[i].key, ops[i].value,
+                                             ops[i].ts)
+                                    ? 1
+                                    : 0);
+    }
+    return out;
+  }
   // Tombstone timestamp for a deleted key, if one is recorded.
   virtual std::optional<uint64_t> tombstone_ts(const std::string& key) = 0;
   // Sorted (key, delete-ts) tombstones with the given prefix ("" = all).
@@ -164,6 +193,7 @@ class MemEngine : public Engine {
   bool set_if_newer(const std::string& key, const std::string& value,
                     uint64_t ts) override;
   bool del_if_newer(const std::string& key, uint64_t ts) override;
+  std::vector<uint8_t> apply_batch(const std::vector<BatchOp>& ops) override;
   std::optional<uint64_t> tombstone_ts(const std::string& key) override;
   std::vector<std::pair<std::string, uint64_t>> tombstones(
       const std::string& prefix) override;
@@ -216,7 +246,15 @@ class MemEngine : public Engine {
   // Records the deletion; returns whether the tombstone advanced (new, or
   // moved to a later ts). Caller holds the shard's unique lock.
   bool note_tomb(Shard& s, const std::string& key, uint64_t ts);
+  // LWW-conditional cores with the caller holding the shard's unique lock
+  // — shared by the single-op verbs and the per-shard-grouped apply_batch.
+  bool set_if_newer_locked(Shard& s, const std::string& key,
+                           const std::string& value, uint64_t ts);
+  bool del_if_newer_locked(Shard& s, const std::string& key, uint64_t ts);
   Shard& shard_for(const std::string& key);
+  size_t shard_index(const std::string& key) const {
+    return std::hash<std::string>{}(key) % kShards;
+  }
   void bump_version() {
     version_.fetch_add(1, std::memory_order_acq_rel);
   }

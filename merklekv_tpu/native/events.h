@@ -9,6 +9,7 @@
 #pragma once
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <mutex>
@@ -47,12 +48,19 @@ class EventQueue {
             bool has_value);
   // Pops up to max_events (0 = all).
   std::vector<ChangeRecord> drain(size_t max_events);
+  // Blocks until the queue is non-empty or timeout_ms elapses; returns
+  // whether events are pending. The drain thread parks here instead of
+  // polling on a fixed interval — the first staged write wakes it, which
+  // removes both the idle-latency floor (poll-interval/2 on average) and
+  // the idle wakeup CPU. timeout_ms <= 0 is a non-blocking peek.
+  bool wait_nonempty(int timeout_ms);
   size_t size() const;
   uint64_t dropped() const { return dropped_.load(std::memory_order_relaxed); }
 
  private:
   const size_t capacity_;
   mutable std::mutex mu_;
+  std::condition_variable cv_;
   std::deque<ChangeRecord> q_;
   uint64_t next_seq_ = 0;
   std::atomic<uint64_t> dropped_{0};

@@ -103,6 +103,10 @@ def _load() -> ctypes.CDLL:
     lib.mkv_engine_del_quiet.argtypes = lib.mkv_engine_del.argtypes
     lib.mkv_engine_set_if_newer.argtypes = lib.mkv_engine_set_with_ts.argtypes
     lib.mkv_engine_del_if_newer.argtypes = lib.mkv_engine_del_with_ts.argtypes
+    lib.mkv_engine_apply_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+        P(ctypes.c_void_p),
+    ]
     lib.mkv_engine_tombstone_ts.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, P(ctypes.c_ulonglong),
     ]
@@ -167,6 +171,7 @@ def _load() -> ctypes.CDLL:
     ]
     lib.mkv_server_events_dropped.restype = ctypes.c_longlong
     lib.mkv_server_events_dropped.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_wait_events.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mkv_server_stats.argtypes = [
         ctypes.c_void_p, P(ctypes.c_void_p), P(ctypes.c_int),
     ]
@@ -279,6 +284,34 @@ class NativeEngine:
         """Delete iff ts is strictly newer than the live entry; records the
         tombstone. Returns whether it applied."""
         return bool(self._lib.mkv_engine_del_if_newer(self._h, key, len(key), ts))
+
+    def apply_batch(
+        self, ops: list[tuple[bytes, Optional[bytes], int]]
+    ) -> list[bool]:
+        """Run a whole replication frame of LWW-conditional ops in ONE FFI
+        crossing: each op is ``(key, value, ts)`` with ``value=None``
+        meaning delete_if_newer and anything else set_if_newer. Returns one
+        applied flag per op (same order). The native side groups ops per
+        shard so a k-op frame pays one lock per touched shard, not k."""
+        if not ops:
+            return []
+        parts = [struct.pack("<I", len(ops))]
+        for key, value, ts in ops:
+            is_del = value is None
+            v = b"" if is_del else value
+            parts.append(struct.pack("<BQI", 1 if is_del else 0, ts, len(key)))
+            parts.append(key)
+            parts.append(struct.pack("<I", len(v)))
+            parts.append(v)
+        buf = b"".join(parts)
+        out = ctypes.c_void_p()
+        n = self._lib.mkv_engine_apply_batch(
+            self._h, buf, len(buf), ctypes.byref(out)
+        )
+        if n < 0:
+            raise NativeError("apply_batch: malformed op buffer")
+        flags = _take_buffer(self._lib, out, n)
+        return [bool(b) for b in flags]
 
     def tombstone_ts(self, key: bytes) -> Optional[int]:
         ts = ctypes.c_ulonglong()
@@ -569,6 +602,16 @@ class NativeServer:
 
     def events_dropped(self) -> int:
         return self._lib.mkv_server_events_dropped(self._h)
+
+    def wait_events(self, timeout_ms: int) -> bool:
+        """Park until the change-event queue is non-empty (or the timeout
+        elapses); returns whether events are pending. The drain threads use
+        this instead of interval polling — the first staged write wakes
+        them, so a single SET replicates in the notify latency, not half a
+        poll interval, and an idle node stops burning poll wakeups."""
+        if not self._h:
+            return False
+        return bool(self._lib.mkv_server_wait_events(self._h, timeout_ms))
 
     def stats_text(self) -> str:
         if not self._h:

@@ -27,7 +27,12 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-from merklekv_tpu.obs.metrics import BUCKET_BOUNDS, Metrics, get_metrics
+from merklekv_tpu.obs.metrics import (
+    BUCKET_BOUNDS,
+    SIZE_SCALE,
+    Metrics,
+    get_metrics,
+)
 
 __all__ = ["MetricsExporter", "render_prometheus"]
 
@@ -145,18 +150,27 @@ def render_prometheus(
                 out, "mkv_span_duration_seconds",
                 f'span="{sname}",', cumulative, h["sum"], h["count"],
             )
+    size_names = set(snap.get("size_histograms", ()))
     for name in sorted(snap["histograms"]):
         if name.startswith("span."):
             continue
         h = snap["histograms"][name]
-        family = f"mkv_{_san(name)}_seconds"
+        # Size/count histograms (observe_size) store values scaled by
+        # SIZE_SCALE so the shared log2 buckets read as 2^i UNITS; render
+        # them unitless with unit-valued bounds instead of `_seconds`.
+        is_size = name in size_names
+        scale = 1.0 / SIZE_SCALE if is_size else 1.0
+        suffix = "" if is_size else "_seconds"
+        family = f"mkv_{_san(name)}{suffix}"
         out.append(f"# TYPE {family} histogram")
         cum, cumulative = 0, []
         for bound, c in zip(BUCKET_BOUNDS, h["counts"]):
             cum += c
-            cumulative.append((bound, cum))
+            cumulative.append((bound * scale, cum))
         cumulative.append((math.inf, cum + h["counts"][-1]))
-        _render_histogram(out, family, "", cumulative, h["sum"], h["count"])
+        _render_histogram(
+            out, family, "", cumulative, h["sum"] * scale, h["count"]
+        )
 
     for name, g in sorted(reg.gauges_snapshot().items()):
         san = _san(name)

@@ -37,6 +37,12 @@ __all__ = [
 # command-latency histogram (stats.h, µs buckets) line up bound-for-bound.
 BUCKET_BOUNDS: tuple[float, ...] = tuple(1e-6 * (1 << i) for i in range(26))
 
+# Size/count histograms (batch sizes, row counts) reuse the same log2
+# machinery by storing observations scaled by SIZE_SCALE: bound i then
+# reads as 2^i UNITS (1, 2, 4, ... ~33.5M). Consumers (the exporter, the
+# bench JSON) multiply bounds/sums back by 1/SIZE_SCALE.
+SIZE_SCALE = 1e-6
+
 
 def bucket_index(seconds: float) -> int:
     """Index of the first bound >= ``seconds`` (len(BUCKET_BOUNDS) for the
@@ -134,6 +140,7 @@ class Metrics:
         self._span_count: dict[str, int] = {}
         self._span_total_s: dict[str, float] = {}
         self._histograms: dict[str, Histogram] = {}
+        self._size_names: set[str] = set()
         self._gauges: dict[str, tuple[GaugeFn, str, str]] = {}
 
     # -- counters -----------------------------------------------------------
@@ -153,6 +160,19 @@ class Metrics:
 
     def observe(self, name: str, seconds: float) -> None:
         self.histogram(name).observe(seconds)
+
+    def observe_size(self, name: str, units: float) -> None:
+        """Size/count observation (e.g. replication batch size): same log2
+        buckets, bound i = 2^i units. The name is tagged so the exporter
+        renders the family unitless (``mkv_<name>``) with unit-valued
+        bounds instead of a ``_seconds`` family."""
+        with self._mu:
+            self._size_names.add(name)
+        self.histogram(name).observe(units * SIZE_SCALE)
+
+    def is_size_histogram(self, name: str) -> bool:
+        with self._mu:
+            return name in self._size_names
 
     def observe_span(self, name: str, seconds: float) -> None:
         """Span aggregate (count + total) AND the span's latency histogram —
@@ -216,6 +236,7 @@ class Metrics:
                 },
             }
             hists = dict(self._histograms)
+            snap["size_histograms"] = sorted(self._size_names)
         snap["histograms"] = {
             name: h.snapshot() for name, h in hists.items()
         }
@@ -229,6 +250,7 @@ class Metrics:
             self._span_count.clear()
             self._span_total_s.clear()
             self._histograms.clear()
+            self._size_names.clear()
 
 
 _metrics = Metrics()

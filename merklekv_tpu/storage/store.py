@@ -324,7 +324,6 @@ class DurableStore:
         self._paused = False
 
     def _drain_loop(self) -> None:
-        interval = 0.005
         while not self._stop_evt.is_set():
             with self._drain_iter_mu:
                 if self._paused or self._server is None:
@@ -339,7 +338,14 @@ class DurableStore:
             if raws is None:
                 time.sleep(0.02)
             elif not raws:
-                time.sleep(interval)
+                # Park on the native queue's notify (the same event-driven
+                # wait the replicator drain uses): the first staged write
+                # wakes the WAL drain immediately, and an idle node stops
+                # paying 5 ms poll wakeups.
+                try:
+                    self._server.wait_events(50)
+                except Exception:
+                    time.sleep(0.005)
 
     def _ticker_loop(self) -> None:
         cfg = self._cfg
@@ -407,6 +413,25 @@ class DurableStore:
 
     def record_delete(self, key: bytes, ts: int) -> None:
         self._append_many([WalRecord(walmod.OP_DEL, key, None, ts)])
+
+    def record_applied(
+        self, items: list[tuple[bytes, Optional[bytes], int]]
+    ) -> None:
+        """Journal one applied replication frame as a grouped WAL append:
+        ``(key, value|None-for-delete, exact LWW ts)`` per op, one
+        ``write()``/fsync decision for the whole frame (append_many
+        batches the encoded frames into a single kernel write)."""
+        self._append_many(
+            [
+                WalRecord(
+                    walmod.OP_DEL if value is None else walmod.OP_SET,
+                    key,
+                    value,
+                    ts,
+                )
+                for key, value, ts in items
+            ]
+        )
 
     def _append_many(self, recs: list[WalRecord]) -> None:
         if not recs or self._writer is None:

@@ -315,19 +315,33 @@ class WalWriter:
                 self._dirty = False
 
     def append_many(self, recs: Iterable[WalRecord]) -> int:
-        """Append a drained batch; with ``always`` one fsync covers it."""
+        """Append a drained batch: frames accumulate into one buffer and go
+        down in a single ``write(2)`` per segment stretch (a k-record
+        replication frame costs one kernel write, not k), with one fsync
+        decision for the whole batch. A crash can still only tear the tail
+        — frames are contiguous, so a partial write cuts at some frame
+        boundary-or-mid-frame suffix exactly like a torn single append."""
         n = 0
         with self._mu:
+            buf = bytearray()
             for rec in recs:
                 frame = encode_frame(rec)
-                if self._size + len(frame) > self._segment_bytes and (
-                    self._size > len(SEGMENT_MAGIC)
-                ):
+                if self._size + len(buf) + len(frame) > self._segment_bytes \
+                        and self._size + len(buf) > len(SEGMENT_MAGIC):
+                    if buf:
+                        os.write(self._fd, buf)
+                        self._size += len(buf)
+                        buf = bytearray()
+                        # Mark before rotating so the closing segment gets
+                        # its fsync (rotate flushes only when dirty).
+                        self._dirty = True
                     self._rotate_locked()
-                os.write(self._fd, frame)
-                self._size += len(frame)
+                buf += frame
                 self.appended += 1
                 n += 1
+            if buf:
+                os.write(self._fd, buf)
+                self._size += len(buf)
             if n:
                 self._dirty = True
                 if self._policy == "always":

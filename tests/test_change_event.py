@@ -19,6 +19,11 @@ from merklekv_tpu.cluster import (
     encode_cbor,
     encode_json,
 )
+from merklekv_tpu.cluster.change_event import (
+    coalesce_events,
+    decode_events,
+    encode_batch_cbor,
+)
 
 
 def ev(**kw) -> ChangeEvent:
@@ -71,6 +76,120 @@ def test_op_id_validation():
     with pytest.raises(ValueError):
         ChangeEvent(op=OpKind.SET, key="k", val=b"v", ts=1, src="s",
                     prev=b"tooshort")
+
+
+# ------------------------------------------------------------- batch frame
+
+def test_batch_envelope_roundtrip():
+    evs = [
+        ev(key="a", val=b"1", ts=10),
+        ev(op=OpKind.DEL, key="b", val=None, ts=20),
+        ev(key=b"bin\xff\xfe".decode("utf-8", "surrogateescape"),
+           val=b"\x00raw", ts=30),
+        ev(op=OpKind.INCR, key="n", val=b"7", ts=40, prev=b"\xab" * 32,
+           ttl=60),
+    ]
+    frame = encode_batch_cbor(evs, "node-α")
+    out = decode_events(frame)
+    # src rides the envelope once and is reinstated per event.
+    assert out == [
+        ChangeEvent(**{**e.__dict__, "src": "node-α"}) for e in evs
+    ]
+    # One frame is smaller than the per-event payloads it replaces.
+    assert len(frame) < sum(len(encode_cbor(e)) for e in evs)
+
+
+def test_decode_events_accepts_all_single_formats():
+    e = ev(ts=77)
+    for enc in (encode_cbor, encode_binary, encode_json):
+        assert decode_events(enc(e)) == [e]
+    with pytest.raises(ValueError):
+        decode_events(b"\x00garbage")
+
+
+def test_coalesce_events_last_write_per_key_wins():
+    evs = [
+        ev(key="a", val=b"1", ts=1, op_id=b"\x01" * 16),
+        ev(key="b", val=b"2", ts=2, op_id=b"\x02" * 16),
+        ev(key="a", val=b"3", ts=3, op_id=b"\x03" * 16),
+        ev(op=OpKind.DEL, key="b", val=None, ts=4, op_id=b"\x04" * 16),
+        ev(key="c", val=b"5", ts=5, op_id=b"\x05" * 16),
+    ]
+    kept, dropped = coalesce_events(evs)
+    assert dropped == 2
+    assert [(e.key, e.op) for e in kept] == [
+        ("a", OpKind.SET), ("b", OpKind.DEL), ("c", OpKind.SET),
+    ]
+    # The survivors are the LAST event per key (post-op values make that
+    # sufficient to reproduce final state).
+    assert kept[0].val == b"3"
+
+
+def test_batch_envelope_unknown_version_raises():
+    frame = encode_batch_cbor([ev()], "s")
+    bad = frame.replace(b"\x61v\x01", b"\x61v\x09", 1)  # v: 1 -> v: 9
+    with pytest.raises(ValueError, match="envelope version"):
+        decode_events(bad)
+
+
+def test_batch_envelope_malformed_shapes_raise():
+    # events not an array
+    head = b"\xa3" + b"\x61v\x01" + b"\x63src\x61s" + b"\x66events\x01"
+    with pytest.raises(ValueError):
+        decode_events(head)
+    # event entry not a map
+    frame = (b"\xa3" + b"\x61v\x01" + b"\x63src\x61s" + b"\x66events"
+             + b"\x81\x05")
+    with pytest.raises(ValueError):
+        decode_events(frame)
+    # val decoded as a non-bytes CBOR item is rejected at the boundary
+    # (letting it through would blow up inside the applier's FFI instead).
+    bad_event = (
+        b"\xa8"                       # map(8): event without src
+        + b"\x61v\x01"                # v: 1
+        + b"\x62op\x63set"            # op: "set"
+        + b"\x63key\x61k"             # key: "k"
+        + b"\x63val\x07"              # val: 7  (uint, INVALID)
+        + b"\x62ts\x01"               # ts: 1
+        + b"\x65op_id\x50" + b"\x00" * 16
+        + b"\x64prev\xf6"
+        + b"\x63ttl\xf6"
+    )
+    env = (b"\xa3" + b"\x61v\x01" + b"\x63src\x61s" + b"\x66events"
+           + b"\x81" + bad_event)
+    with pytest.raises(ValueError, match="val must be bytes"):
+        decode_events(env)
+
+
+def test_batch_envelope_truncation_fuzz_never_crashes():
+    frame = encode_batch_cbor(
+        [ev(key=f"k{i}", val=b"v%d" % i, ts=i + 1) for i in range(5)], "s"
+    )
+    for cut in range(len(frame)):
+        with pytest.raises(ValueError):
+            decode_events(frame[:cut])
+
+
+def test_batch_envelope_byte_flip_fuzz_never_crashes():
+    import random
+
+    frame = encode_batch_cbor(
+        [ev(key=f"k{i}", val=b"v%d" % i, ts=i + 1) for i in range(4)], "s"
+    )
+    rng = random.Random(1234)
+    for _ in range(500):
+        buf = bytearray(frame)
+        for _ in range(rng.randint(1, 3)):
+            buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        try:
+            out = decode_events(bytes(buf))
+        except ValueError:
+            continue  # counted-and-dropped is the contract
+        # A surviving decode must be a well-formed event list.
+        assert isinstance(out, list)
+        for e in out:
+            assert isinstance(e, ChangeEvent)
+            assert e.val is None or isinstance(e.val, bytes)
 
 
 # ------------------------------------------------------------------ applier
@@ -157,6 +276,71 @@ def test_per_key_independence(store_and_applier):
     a.apply(ev(key="a", ts=100, val=b"1"))
     assert a.apply(ev(key="b", ts=50, val=b"2"))  # other key, older ts fine
     assert store == {b"a": b"1", b"b": b"2"}
+
+
+def test_apply_batch_fallback_matches_per_event(store_and_applier):
+    """Without an engine batch fn, apply_batch is exactly the per-event
+    path: same applied set, same counters."""
+    store, a = store_and_applier
+    evs = [
+        ev(key="x", val=b"1", ts=10, op_id=b"\x0a" * 16),
+        ev(key="x", val=b"0", ts=5, op_id=b"\x0b" * 16),   # stale
+        ev(key="y", val=b"2", ts=20, op_id=b"\x0c" * 16),
+        ev(key="y", val=b"2", ts=20, op_id=b"\x0c" * 16),  # dup op_id
+    ]
+    applied = a.apply_batch(evs)
+    assert [e.key for e in applied] == ["x", "y"]
+    assert store == {b"x": b"1", b"y": b"2"}
+    assert a.skipped_dup == 1 and a.skipped_lww == 1
+
+
+def test_apply_batch_engine_backed_one_ffi_crossing():
+    """With the native engine's batch fn wired, a frame's surviving ops
+    cross the FFI once, per-op flags drive the counters, and the outcome
+    matches the per-event conditional verbs."""
+    from merklekv_tpu.native_bindings import NativeEngine
+
+    eng = NativeEngine("mem")
+    calls = []
+
+    def batch_fn(ops):
+        calls.append(len(ops))
+        return eng.apply_batch(ops)
+
+    try:
+        a = LWWApplier(
+            eng.set,
+            lambda k: eng.delete(k),
+            set_ts_fn=lambda k, v, t: eng.set_if_newer(k, v, t),
+            del_ts_fn=lambda k, t: eng.delete_if_newer(k, t),
+            apply_batch_fn=batch_fn,
+        )
+        evs = [
+            ev(key="a", val=b"1", ts=100, op_id=b"\x01" * 16),
+            ev(op=OpKind.DEL, key="b", val=None, ts=200, op_id=b"\x02" * 16),
+            ev(key="a", val=b"0", ts=50, op_id=b"\x03" * 16),  # mem-floor stale
+            ev(key="a", val=b"1", ts=100, op_id=b"\x01" * 16),  # dup
+        ]
+        applied = a.apply_batch(evs)
+        # ONE engine crossing: the intra-frame duplicate is filtered here;
+        # the stale op rides along and the ENGINE's flag rejects it.
+        assert calls == [3]
+        assert [e.op_id for e in applied] == [b"\x01" * 16, b"\x02" * 16]
+        assert eng.get(b"a") == b"1"
+        assert eng.tombstone_ts(b"b") == 200
+        assert a.applied == 2 and a.skipped_dup == 1 and a.skipped_lww == 1
+        # A second frame with an ENGINE-stale op (ts below the installed
+        # value, applier restarted so in-memory floor is empty) is rejected
+        # by the engine flag, not silently applied.
+        a2 = LWWApplier(
+            eng.set, lambda k: eng.delete(k), apply_batch_fn=eng.apply_batch
+        )
+        out = a2.apply_batch([ev(key="a", val=b"old", ts=1,
+                                 op_id=b"\x04" * 16)])
+        assert out == [] and a2.skipped_lww == 1
+        assert eng.get(b"a") == b"1"
+    finally:
+        eng.close()
 
 
 def test_codecs_round_trip_non_utf8_key():

@@ -171,12 +171,12 @@ def test_metrics_verb_serves_control_plane_counters(server):
         # Counters are numeric text across the board.
         assert all(v.lstrip("-").isdigit() for v in snap.values()), snap
         # Span aggregates ride along (any span recorded by the control
-        # plane shows as .count/.total_us plus deprecated .total_ms and
-        # bucket-derived percentiles — may be absent if no span has run
-        # yet in this process).
+        # plane shows as .count/.total_us plus bucket-derived percentiles
+        # — may be absent if no span has run yet in this process; the
+        # deprecated .total_ms is gone after its one-release window).
         for k in snap:
             if k.startswith("span."):
-                assert k.endswith((".count", ".total_us", ".total_ms",
+                assert k.endswith((".count", ".total_us",
                                    ".p50_us", ".p99_us")), k
     finally:
         node.stop()

@@ -209,6 +209,22 @@ def test_render_prometheus_grammar_full_surface():
     assert "uptime:0d" not in body
 
 
+def test_size_histogram_renders_unitless_family():
+    """observe_size histograms (replication batch sizes) share the log2
+    bucket machinery but render as a unitless family — bounds in UNITS
+    (2^i events), no `_seconds` suffix, sum rescaled back to units."""
+    m = Metrics()
+    m.observe_size("replicator.batch_size", 7)
+    m.observe_size("replicator.batch_size", 300)
+    body = render_prometheus(m)
+    _assert_prometheus_grammar(body)
+    assert "mkv_replicator_batch_size_seconds" not in body
+    assert 'mkv_replicator_batch_size_bucket{le="8"} 1' in body
+    assert 'mkv_replicator_batch_size_bucket{le="512"} 2' in body
+    assert "mkv_replicator_batch_size_count 2" in body
+    assert "mkv_replicator_batch_size_sum 307" in body
+
+
 def test_exporter_endpoint_two_node_cluster(cluster_node):
     """Acceptance shape: a 2-node cluster under write + anti-entropy load
     serves a Prometheus-parseable /metrics page with histogram series, a
@@ -317,9 +333,9 @@ def test_metrics_parity_sync_async_cluster_attached(cluster_node):
 
 
 def test_span_total_us_not_truncated(cluster_node):
-    """Satellite: sub-millisecond spans used to report total_ms 0 — the
-    canonical total is now microseconds (total_ms kept one release,
-    deprecated in PROTOCOL.md)."""
+    """Sub-millisecond spans used to report total_ms 0; the canonical total
+    is microseconds, and the deprecated total_ms field is GONE from the
+    wire after its one-release window (PROTOCOL.md "METRICS")."""
     _, srv, _node = cluster_node
     get_metrics().reset()
     # Deterministic sub-ms observation (a sleep-based span can overshoot
@@ -328,7 +344,7 @@ def test_span_total_us_not_truncated(cluster_node):
     with MerkleKVClient("127.0.0.1", srv.port) as c:
         m = c.metrics()
     assert int(m["span.obs_tiny.op.total_us"]) > 0
-    assert int(m["span.obs_tiny.op.total_ms"]) == 0  # the bug being fixed
+    assert "span.obs_tiny.op.total_ms" not in m  # deprecation window over
     assert int(m["span.obs_tiny.op.p50_us"]) > 0
 
 

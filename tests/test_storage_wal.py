@@ -169,6 +169,25 @@ def test_append_many_batches(tmp_path):
     assert scan.clean and len(scan.records) == 25
 
 
+def test_append_many_single_write_across_rotation(tmp_path):
+    """The grouped append (one write() per segment stretch) must keep
+    every frame intact across forced segment rotations: the full record
+    stream survives, in order, split over clean segments."""
+    recs = _records(40)
+    w = wal.WalWriter(
+        str(tmp_path), 0, fsync_policy="never", segment_bytes=256
+    )
+    assert w.append_many(recs) == 40
+    assert w.rotations >= 2  # the batch genuinely crossed segments
+    w.close()
+    replayed = []
+    for _seq, path in wal.list_segments(str(tmp_path)):
+        scan = wal.scan_segment(path)
+        assert scan.clean, scan.error
+        replayed.extend(scan.records)
+    assert replayed == recs
+
+
 def test_reopen_with_start_offset_cuts_torn_tail(tmp_path):
     recs = _records(5)
     path = _write_segment(str(tmp_path), recs)
