@@ -343,6 +343,91 @@ def bench_sync_wire_bytes(n_keys: int) -> dict:
         eng_b.close()
 
 
+def bench_bootstrap_rejoin(n_keys: int) -> dict:
+    """Node-rejoin A/B (ISSUE 6 tentpole evidence): rebuild an empty
+    replica from a donor holding n_keys, once via verified snapshot
+    shipping + delta walk (SNAPMETA/SNAPCHUNK, cluster/bootstrap.py) and
+    once via the walk-only anti-entropy rebuild — recording wire bytes and
+    time-to-converged-root for each. The walk-only path is the bisect
+    walk's pathological worst case (every subtree diverges); the snapshot
+    path ships the keyspace as one compressed, CRC-framed, stamp-verified
+    artifact and bisects only the post-stamp delta."""
+    import tempfile
+
+    from merklekv_tpu.cluster.bootstrap import BootstrapSession
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.cluster.sync import SyncManager
+    from merklekv_tpu.config import BootstrapConfig, Config
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+    from merklekv_tpu.storage import DurableStore
+
+    tmp = tempfile.mkdtemp(prefix="mkv-bench-bootstrap-")
+    cfg = Config()
+    cfg.storage.enabled = True
+    eng_a = NativeEngine("mem")
+    storage = DurableStore(eng_a, cfg.storage, tmp)
+    storage.recover()
+    srv_a = NativeServer(eng_a, "127.0.0.1", 0)
+    srv_a.start()
+    node_a = ClusterNode(cfg, eng_a, srv_a, storage=storage)
+    node_a.start()
+    try:
+        for i in range(n_keys):
+            eng_a.set(b"bj:%08d" % i, b"val-%08d" % i)
+        root_a = eng_a.merkle_root()
+
+        # Snapshot-shipping rejoin.
+        eng_b = NativeEngine("mem")
+        try:
+            sess = BootstrapSession(
+                eng_b,
+                SyncManager(eng_b),
+                [f"127.0.0.1:{srv_a.port}"],
+                BootstrapConfig(),
+            )
+            t0 = time.perf_counter()
+            report = sess.run("bench-rejoin")
+            boot_s = time.perf_counter() - t0
+            assert report.mode == "snapshot", report.details
+            assert eng_b.merkle_root() == root_a
+            boot_bytes = report.wire_bytes
+        finally:
+            eng_b.close()
+
+        # Walk-only rebuild of the identical state.
+        eng_c = NativeEngine("mem")
+        try:
+            mgr = SyncManager(eng_c)
+            t0 = time.perf_counter()
+            rep = mgr.sync_once("127.0.0.1", srv_a.port)
+            walk_s = time.perf_counter() - t0
+            assert eng_c.merkle_root() == root_a
+            walk_bytes = rep.bytes_sent + rep.bytes_received
+        finally:
+            eng_c.close()
+
+        return {
+            "metric": "bootstrap_rejoin",
+            "value": boot_bytes,
+            "unit": "wire bytes (snapshot shipping, ingest->converged root)",
+            "n": n_keys,
+            "bootstrap_bytes": boot_bytes,
+            "bootstrap_s": round(boot_s, 3),
+            "walk_bytes": walk_bytes,
+            "walk_s": round(walk_s, 3),
+            "bytes_fraction": round(boot_bytes / max(walk_bytes, 1), 4),
+            "snapshot_raw_bytes": report.bytes_fetched,
+            "chunks": report.chunks,
+            "target": 0.25,
+            "target_met": boot_bytes < 0.25 * walk_bytes,
+        }
+    finally:
+        node_a.stop()
+        storage.stop()
+        srv_a.close()
+        eng_a.close()
+
+
 def bench_replicated_write_throughput(n_events: int) -> dict:
     """Batched replication pipeline A/B (this PR's tentpole evidence).
 
@@ -699,6 +784,12 @@ def _run(backend: str) -> None:
     except Exception as e:
         print(f"# replicated_write_throughput bench failed: {e!r}",
               file=sys.stderr)
+    try:
+        configs.append(
+            bench_bootstrap_rejoin(n_keys=100_000 if on_tpu else 20_000)
+        )
+    except Exception as e:
+        print(f"# bootstrap_rejoin bench failed: {e!r}", file=sys.stderr)
 
     # Every emitted record carries the run's metrics snapshot (counters +
     # span aggregates) so a BENCH_*.json trajectory shows what the run

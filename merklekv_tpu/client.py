@@ -14,8 +14,10 @@ responses raised as ProtocolError.
 from __future__ import annotations
 
 import asyncio
+import base64
 import os
 import socket
+import zlib
 from typing import Iterable, Optional, Sequence
 
 DEFAULT_PORT = int(os.environ.get("MERKLEKV_PORT", "7379"))
@@ -33,6 +35,13 @@ class ProtocolError(MerkleKVError):
     """Server returned ERROR or an unexpected response."""
 
 
+class ChunkIntegrityError(MerkleKVError):
+    """A SNAPCHUNK frame failed its CRC/length check after decode — the
+    bytes on the wire are NOT the bytes the donor read. Distinct from
+    ProtocolError (which signals a capability miss / ERROR answer) so the
+    bootstrap fetch retries the same offset instead of failing the donor."""
+
+
 # --------------------------------------------------------------- parsing
 
 def _parse_simple(resp: str) -> str:
@@ -48,6 +57,64 @@ def _parse_value(resp: str) -> Optional[str]:
     if resp.startswith("VALUE "):
         return resp[6:]
     raise ProtocolError(f"unexpected response: {resp}")
+
+
+def _parse_snapmeta(resp: str) -> tuple[int, int, int, str]:
+    """Parse a SNAPMETA response line (shared sync/async)."""
+    if not resp.startswith("SNAPMETA "):
+        raise ProtocolError(f"unexpected response: {resp}")
+    try:
+        seq_s, wal_s, size_s, root = resp[9:].split(" ")
+        seq, wal_seq, size = int(seq_s), int(wal_s), int(size_s)
+        if len(bytes.fromhex(root)) != 32:
+            raise ValueError("root must be 32 bytes")
+    except ValueError as e:
+        raise ProtocolError(f"malformed SNAPMETA response: {resp!r}") from e
+    return seq, wal_seq, size, root
+
+
+def _parse_chunk_header(resp: str) -> tuple[int, int, int]:
+    """Parse a CHUNK header line into (offset, rawlen, crc32)."""
+    if not resp.startswith("CHUNK "):
+        raise ProtocolError(f"unexpected response: {resp}")
+    try:
+        off_s, rawlen_s, crc_s = resp[6:].split(" ")
+        return int(off_s), int(rawlen_s), int(crc_s)
+    except ValueError as e:
+        raise ProtocolError(f"malformed CHUNK response: {resp!r}") from e
+
+
+def _decode_chunk(
+    off: int, rawlen: int, crc: int, payload: str, requested_offset: int
+) -> bytes:
+    """Decode + verify one SNAPCHUNK payload line (shared sync/async).
+
+    Every failure mode of a hostile wire — truncated base64, flipped bytes,
+    an offset echo that doesn't match the request, a length or CRC that
+    disagrees with the decoded bytes — raises ChunkIntegrityError so the
+    fetch retries cleanly and partial data can never be returned."""
+    if off != requested_offset:
+        raise ChunkIntegrityError(
+            f"chunk offset mismatch: asked {requested_offset}, got {off}"
+        )
+    if rawlen == 0:
+        if payload:
+            raise ChunkIntegrityError("zero-length chunk carried payload")
+        return b""
+    try:
+        # validate=True: b64decode otherwise silently DISCARDS non-alphabet
+        # bytes, which would let a flipped byte vanish instead of failing.
+        comp = base64.b64decode(payload.encode("ascii"), validate=True)
+        raw = zlib.decompress(comp)
+    except Exception as e:
+        raise ChunkIntegrityError(f"chunk decode failed: {e}") from None
+    if len(raw) != rawlen:
+        raise ChunkIntegrityError(
+            f"chunk length mismatch: header says {rawlen}, decoded {len(raw)}"
+        )
+    if zlib.crc32(raw) != crc:
+        raise ChunkIntegrityError("chunk crc mismatch")
+    return raw
 
 
 def _count_after(resp: str, prefix: str) -> int:
@@ -360,6 +427,28 @@ class MerkleKVClient:
             rows.append((idx, hexd))
         return rows, n
 
+    def snap_meta(self) -> tuple[int, int, int, str]:
+        """Newest shippable snapshot on the peer (SNAPMETA): ``(seq,
+        wal_seq, size_bytes, root_hex)``. A peer without durable storage —
+        or an old-version peer without the verb — answers ERROR, raised
+        here as ProtocolError: the joiner's capability-fallback signal to
+        degrade to the plain anti-entropy walk."""
+        return _parse_snapmeta(_parse_simple(self._request("SNAPMETA")))
+
+    def snap_chunk(self, seq: int, offset: int, count: int) -> bytes:
+        """One verified byte range of snapshot ``seq`` (SNAPCHUNK): the
+        raw bytes at ``offset`` (possibly short at EOF, empty past it).
+        The frame travels zlib-compressed + base64 with the RAW length and
+        CRC32 in the header; any mismatch after decode raises
+        :class:`ChunkIntegrityError` — the caller retries the offset, and
+        a partial/corrupt frame can never be applied."""
+        resp = _parse_simple(
+            self._request(f"SNAPCHUNK {seq} {offset} {count}")
+        )
+        off, rawlen, crc = _parse_chunk_header(resp)
+        payload = self._read_line()
+        return _decode_chunk(off, rawlen, crc, payload, offset)
+
     # -- admin ---------------------------------------------------------------
     def ping(self, message: str = "") -> str:
         cmd = f"PING {message}" if message else "PING"
@@ -517,8 +606,13 @@ class AsyncMerkleKVClient:
 
     async def connect(self) -> "AsyncMerkleKVClient":
         try:
+            # limit: StreamReader.readline defaults to a 64 KiB cap and
+            # raises a bare ValueError past it — a SNAPCHUNK payload line
+            # (base64 of up to a 256 KiB raw range) and large MGET value
+            # lines both exceed that legitimately.
             self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(self.host, self.port), self.timeout
+                asyncio.open_connection(self.host, self.port, limit=1 << 20),
+                self.timeout,
             )
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectionError(
@@ -659,6 +753,21 @@ class AsyncMerkleKVClient:
                 raise ProtocolError(f"malformed TREELEVEL row: {line!r}") from e
             rows.append((idx, hexd))
         return rows, n
+
+    async def snap_meta(self) -> tuple[int, int, int, str]:
+        """Async SNAPMETA — same semantics as the sync client's
+        ``snap_meta``."""
+        return _parse_snapmeta(_parse_simple(await self._request("SNAPMETA")))
+
+    async def snap_chunk(self, seq: int, offset: int, count: int) -> bytes:
+        """Async SNAPCHUNK — same verify-or-raise semantics as the sync
+        client's ``snap_chunk``."""
+        resp = _parse_simple(
+            await self._request(f"SNAPCHUNK {seq} {offset} {count}")
+        )
+        off, rawlen, crc = _parse_chunk_header(resp)
+        payload = await self._read_line()
+        return _decode_chunk(off, rawlen, crc, payload, offset)
 
     async def ping(self, message: str = "") -> str:
         cmd = f"PING {message}" if message else "PING"

@@ -42,6 +42,9 @@ class ClusterNode:
         self._rep_mu = threading.Lock()
         self._exporter = None  # MetricsExporter, alive while the node runs
         self._gauge_names: list = []  # (name, fn) pairs we registered
+        self._bootstrap = None  # BootstrapSession while a (re)join runs
+        self._bootstrap_thread: Optional[threading.Thread] = None
+        self._stopped = False  # guards late starts from the bootstrap thread
         self.sync_manager = SyncManager(
             engine,
             device=cfg.anti_entropy.engine,
@@ -100,18 +103,47 @@ class ClusterNode:
                 ),
             )
             self._health.start()
+        # Bootstrap BEFORE the periodic sync loop: a node joining empty
+        # (or recovering through interior WAL corruption) ships a peer's
+        # verified snapshot instead of walking the whole keyspace, serving
+        # zero reads until the stamped root verifies. The loop is deferred
+        # until the session FINISHES — a transfer outliving the sync
+        # interval must not race full walk-from-empty cycles (the exact
+        # O(n) wire cost this subsystem exists to avoid); the bootstrap
+        # thread starts the loop on its way out.
+        bootstrapping = False
+        if self._cfg.bootstrap.enabled and self._cfg.anti_entropy.peers:
+            reason = self._bootstrap_reason()
+            if reason is not None:
+                bootstrapping = True
+                self._start_bootstrap(reason)
+        if not bootstrapping:
+            self._start_sync_loop()
+
+    def _start_sync_loop(self) -> None:
+        if (
+            self._cfg.anti_entropy.enabled
+            and self._cfg.anti_entropy.peers
+            and not self._stopped
+        ):
             self.sync_manager.start_loop(
                 self._cfg.anti_entropy.peers,
                 self._cfg.anti_entropy.interval_seconds,
                 multi_peer=self._cfg.anti_entropy.multi_peer,
-                peer_up=self._health.is_up,
+                peer_up=self._health.is_up if self._health else None,
             )
 
     def stop(self) -> None:
+        self._stopped = True
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
         self._unregister_gauges()
+        if self._bootstrap is not None:
+            self._bootstrap.stop()
+        if self._bootstrap_thread is not None:
+            self._bootstrap_thread.join(timeout=10)
+            self._bootstrap_thread = None
         self.sync_manager.stop()
         if self._health is not None:
             self._health.stop()
@@ -206,6 +238,135 @@ class ClusterNode:
                 # warm thread reads through the engine's raw pointer.
                 self._mirror.close()
                 self._mirror = None
+
+    # -- bootstrap (joiner side) ----------------------------------------------
+    @property
+    def bootstrap(self):
+        """The BootstrapSession of the current/most recent (re)join, or
+        None when this node never bootstrapped (tests, top, healthz)."""
+        return self._bootstrap
+
+    def _bootstrap_reason(self) -> Optional[str]:
+        """Why this node should bootstrap, or None to start normally.
+
+        An empty keyspace is the classic new/long-dead joiner. A recovery
+        that hit interior WAL corruption (or rejected every snapshot)
+        restored only a verified PREFIX — the re-anchor snapshot closes
+        the durability hole, and bootstrapping from a healthy peer closes
+        the data hole without waiting out a worst-case walk."""
+        try:
+            if self._engine.dbsize() == 0:
+                return "empty-keyspace"
+        except Exception:
+            return None
+        st = self._storage
+        if st is not None and st.last_recovery is not None:
+            rec = st.last_recovery
+            if rec.corruption:
+                return "wal-corruption"
+            if rec.snapshots_rejected and rec.snapshot_path is None:
+                return "snapshots-rejected"
+        return None
+
+    def _start_bootstrap(self, reason: str) -> None:
+        from merklekv_tpu.cluster.bootstrap import BootstrapSession
+
+        # Close the read gate first: no client read — and no peer's
+        # anti-entropy walk — sees unverified state from here on.
+        self._server.set_serving(False)
+        with self._rep_mu:
+            rep = self._replicator
+        if rep is not None:
+            # Live replication frames journal but defer apply until the
+            # verified snapshot is installed (no gap in the write stream).
+            rep.hold_applies()
+
+        def on_serving() -> None:
+            self._server.set_serving(True)
+            with self._rep_mu:
+                r = self._replicator
+            if r is not None:
+                r.release_applies()
+
+        self._bootstrap = BootstrapSession(
+            self._engine,
+            self.sync_manager,
+            self._cfg.anti_entropy.peers,
+            self._cfg.bootstrap,
+            merkle_engine=self._cfg.storage.merkle_engine,
+            health=self._health,
+            batch_listener=self._on_bootstrap_applied,
+            on_serving=on_serving,
+        )
+        sess = self._bootstrap
+
+        def run() -> None:
+            try:
+                sess.run(reason)
+            finally:
+                # The periodic loop was deferred for the transfer's
+                # duration; hand over to it now (no-op if disabled or the
+                # node stopped meanwhile).
+                self._start_sync_loop()
+
+        self._bootstrap_thread = threading.Thread(
+            target=run, daemon=True, name="mkv-bootstrap"
+        )
+        self._bootstrap_thread.start()
+
+    def _on_bootstrap_applied(self, applied) -> None:
+        """Verified snapshot slab installed into the engine: feed the
+        device mirror and the WAL, exactly like anti-entropy repairs —
+        bootstrap applies bypass the server's event queue."""
+        with self._rep_mu:
+            mirror = self._mirror
+        if mirror is not None:
+            mirror.apply_batch([(k, v) for k, v, _ in applied])
+        if self._storage is not None:
+            self._storage.record_applied(applied)
+
+    def _snap_meta_wire(self) -> str:
+        storage = self._storage
+        if storage is None:
+            return "ERROR snapshot shipping requires durable storage\r\n"
+        meta = storage.donor_meta()
+        if meta == storage.BUILDING:
+            # Transient, not a capability miss: the artifact is being
+            # written in the background — the joiner polls ("retry" is the
+            # signal its discover phase waits on).
+            return "ERROR snapshot not ready (building); retry\r\n"
+        if meta is None:
+            return "ERROR no snapshot available\r\n"
+        seq, wal_seq, size, root_hex = meta
+        return f"SNAPMETA {seq} {wal_seq} {size} {root_hex}\r\n"
+
+    def _snap_chunk_wire(self, seq: int, offset: int, count: int) -> str:
+        import base64
+        import zlib
+
+        storage = self._storage
+        if storage is None:
+            return "ERROR snapshot shipping requires durable storage\r\n"
+        try:
+            raw = storage.read_snapshot_range(seq, offset, count)
+        except OSError:
+            # Artifact gone (donor restarted past the pin TTL): the joiner
+            # re-discovers rather than assembling a short file.
+            return f"ERROR snapshot {seq} gone\r\n"
+        if not raw:
+            # Past EOF: a bare zero-length frame (the client rejects a
+            # zero-length header that still carries payload bytes).
+            return f"CHUNK {offset} 0 0\r\n\r\n"
+        # CRC over the RAW bytes; payload zlib+base64 so the CRLF text
+        # protocol carries arbitrary binary, and key/value-shaped snapshot
+        # bodies compress well (measured: ~5-10x on text keyspaces).
+        payload = base64.b64encode(zlib.compress(raw, 1)).decode("ascii")
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        m = get_metrics()
+        m.inc("bootstrap.donor_chunks")
+        m.inc("bootstrap.donor_bytes", len(raw))
+        return f"CHUNK {offset} {len(raw)} {zlib.crc32(raw)}\r\n{payload}\r\n"
 
     def _on_peer_degraded(self, peer: str, reason: str) -> None:
         """A sync stream against ``peer`` died mid-cycle (its remaining
@@ -338,6 +499,10 @@ class ClusterNode:
                 r.peer: code.get(r.status, -1) for r in h.snapshot()
             }
 
+        def bootstrap_state() -> int:
+            b = self._bootstrap
+            return b.state_code() if b is not None else 0
+
         gauges = [
             ("keyspace.keys", live_keys,
              "Live keys in the native engine.", ""),
@@ -354,6 +519,9 @@ class ClusterNode:
              "heal.", ""),
             ("peer.state", peer_states,
              "Peer health (2=up 1=degraded 0=down -1=unknown).", "peer"),
+            ("bootstrap.state", bootstrap_state,
+             "Bootstrap state machine (0=idle 1=discover 2=fetch 3=verify "
+             "4=delta 5=live -1=failed).", ""),
         ]
         if self._storage is not None:
             storage = self._storage
@@ -448,6 +616,12 @@ class ClusterNode:
             rows, n = out
             body = "".join(f"{i} {d.hex()}\r\n" for i, d in rows)
             return f"NODES {len(rows)} {n}\r\n{body}"
+        if parts[0] == "SNAPMETA":
+            return self._snap_meta_wire()
+        if parts[0] == "SNAPCHUNK":
+            return self._snap_chunk_wire(
+                int(parts[1]), int(parts[2]), int(parts[3])
+            )
         if parts[0] == "SYNC":
             host, port = parts[1], int(parts[2])
             try:

@@ -79,6 +79,10 @@ class Replicator:
     # Conservative per-event envelope overhead (op_id + field heads + ts)
     # used by the batch_max_bytes frame splitter.
     _EVENT_WIRE_OVERHEAD = 64
+    # Bound on frames buffered while a bootstrap holds applies; past it,
+    # frames are journaled + dropped from the buffer (anti-entropy repairs
+    # the residue — same QoS-0 discipline as a publish drop).
+    _MAX_HELD_FRAMES = 8192
 
     def __init__(
         self,
@@ -147,6 +151,14 @@ class Replicator:
         self.decode_errors = 0
         self.publish_errors = 0
         self.coalesced = 0
+        self.buffered = 0
+        # Bootstrap hold: while set, inbound frames JOURNAL (the WAL must
+        # never gap) but defer their engine/mirror apply until the verified
+        # snapshot is installed — then they replay in arrival order through
+        # the same LWW path, so the write stream has no gap and no
+        # unverified state ever serves.
+        self._holding = False
+        self._held: list[list[ChangeEvent]] = []
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -293,6 +305,30 @@ class Replicator:
             src=self.node_id,
         )
 
+    # -- bootstrap hold ------------------------------------------------------
+    def hold_applies(self) -> None:
+        """Enter bootstrap mode: inbound frames journal but defer apply."""
+        with self._applier_mu:
+            self._holding = True
+
+    def release_applies(self) -> int:
+        """Replay every held frame (arrival order) and resume live applies.
+        Returns the number of frames replayed."""
+        with self._applier_mu:
+            frames, self._held = self._held, []
+            self._holding = False
+            replayed = 0
+            for events in frames:
+                # Journaled at buffer time — replay must not re-journal.
+                self._apply_frame(events, journal=False)
+                replayed += len(events)
+            if replayed:
+                # Events, like replicator.buffered: after every release
+                # buffered == buffer_replayed, and buffer_dropped counts
+                # the journaled-but-never-held overflow separately.
+                get_metrics().inc("replicator.buffer_replayed", replayed)
+            return len(frames)
+
     # -- inbound ------------------------------------------------------------
     def _on_message(self, topic: str, payload: bytes) -> None:
         try:
@@ -310,29 +346,58 @@ class Replicator:
         self.received += len(events)
         get_metrics().inc("replicator.received", len(events))
         with self._applier_mu:
-            applied = self._applier.apply_batch(events)
-            if not applied:
+            if self._holding:
+                # Journal NOW (recovery replay is LWW-conditional, so
+                # journaling an event the replay later rejects is safe),
+                # apply after the verified snapshot lands.
+                if self._storage is not None:
+                    self._storage.record_applied(
+                        [
+                            (
+                                ev.key.encode("utf-8", "surrogateescape"),
+                                None if ev.op is OpKind.DEL else ev.val,
+                                ev.ts,
+                            )
+                            for ev in events
+                            if ev.op is not OpKind.TRUNCATE
+                        ]
+                    )
+                if len(self._held) < self._MAX_HELD_FRAMES:
+                    self._held.append(events)
+                    self.buffered += len(events)
+                    get_metrics().inc("replicator.buffered", len(events))
+                else:
+                    # Journaled but not replayable in RAM: anti-entropy
+                    # repairs the residue (frame-loss semantics, counted).
+                    get_metrics().inc("replicator.buffer_dropped",
+                                      len(events))
                 return
-            # Batch fan-out of the applied residue, still under the applier
-            # lock so concurrent frames reach the mirror in engine-apply
-            # order: ONE mirror staging call and ONE grouped WAL append per
-            # frame (the exact LWW ts rides with each op).
-            pairs = [
-                (
-                    ev.key.encode("utf-8", "surrogateescape"),
-                    None if ev.op is OpKind.DEL else ev.val,
-                )
-                for ev in applied
-            ]
-            if self._mirror is not None:
-                self._mirror.apply_batch(pairs)
-            if self._storage is not None:
-                self._storage.record_applied(
-                    [
-                        (key, val, ev.ts)
-                        for (key, val), ev in zip(pairs, applied)
-                    ]
-                )
+            self._apply_frame(events, journal=True)
+
+    def _apply_frame(self, events: list[ChangeEvent], journal: bool) -> None:
+        """Apply one inbound frame (callers hold ``_applier_mu``): ONE
+        native batch crossing, then batch fan-out of the applied residue —
+        ONE mirror staging call and (when ``journal``) ONE grouped WAL
+        append per frame, the exact LWW ts riding with each op."""
+        applied = self._applier.apply_batch(events)
+        if not applied:
+            return
+        pairs = [
+            (
+                ev.key.encode("utf-8", "surrogateescape"),
+                None if ev.op is OpKind.DEL else ev.val,
+            )
+            for ev in applied
+        ]
+        if self._mirror is not None:
+            self._mirror.apply_batch(pairs)
+        if journal and self._storage is not None:
+            self._storage.record_applied(
+                [
+                    (key, val, ev.ts)
+                    for (key, val), ev in zip(pairs, applied)
+                ]
+            )
 
     # -- introspection -------------------------------------------------------
     @property

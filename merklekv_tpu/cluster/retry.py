@@ -38,6 +38,7 @@ __all__ = [
     "SYNC_PEER",
     "HEALTH_PROBE",
     "REPLICATOR_PUBLISH",
+    "BOOTSTRAP_FETCH",
 ]
 
 T = TypeVar("T")
@@ -179,4 +180,17 @@ HEALTH_PROBE = RetryPolicy(
 # transient transport hiccup, then drop and count (anti-entropy repairs).
 REPLICATOR_PUBLISH = RetryPolicy(
     first_delay=0.05, max_delay=0.1, jitter=0.5, attempts=2, op_timeout=5.0
+)
+
+# Bootstrap snapshot fetch: per-chunk retries ride this backoff (the chunk
+# offset is the checkpoint — a retried chunk refetches only itself, never
+# the verified prefix); op_deadline bounds one donor's whole transfer, past
+# which the session fails over to the next donor.
+BOOTSTRAP_FETCH = RetryPolicy(
+    first_delay=0.1,
+    max_delay=2.0,
+    jitter=0.2,
+    attempts=4,
+    op_timeout=30.0,
+    op_deadline=600.0,
 )

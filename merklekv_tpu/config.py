@@ -208,6 +208,29 @@ class StorageConfig:
 
 
 @dataclass
+class BootstrapConfig:
+    """[bootstrap]: elastic-membership node bootstrap (cluster/bootstrap.py).
+
+    When enabled, a node that starts with an empty keyspace — or recovers
+    through interior WAL corruption — fetches a peer's newest Merkle-stamped
+    snapshot over SNAPMETA/SNAPCHUNK, verifies the stamped root locally
+    BEFORE serving a single read, then closes the post-stamp gap with a
+    bisect delta walk. Donors come from [anti_entropy].peers. Peers that
+    cannot serve a snapshot degrade the joiner to the plain anti-entropy
+    walk. See docs/PERSISTENCE.md "Snapshot shipping".
+    """
+
+    enabled: bool = False
+    # Raw snapshot bytes requested per SNAPCHUNK (the resume granularity on
+    # a hostile link). Clamped to [4096, 262144]; the donor additionally
+    # clamps to its own response-buffer budget.
+    chunk_bytes: int = 131072
+    # Integrity/transport retries per chunk offset before failing over to
+    # the next donor.
+    chunk_retries: int = 4
+
+
+@dataclass
 class ObservabilityConfig:
     """[observability]: the metrics plane (merklekv_tpu/obs/).
 
@@ -243,6 +266,7 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    bootstrap: BootstrapConfig = field(default_factory=BootstrapConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig
     )
@@ -356,6 +380,23 @@ class Config:
             raise ValueError(
                 f"[storage] merkle_engine must be auto|cpu|tpu, "
                 f"got {cfg.storage.merkle_engine!r}"
+            )
+        boot = raw.get("bootstrap", {})
+        if "enabled" in boot:
+            cfg.bootstrap.enabled = bool(boot["enabled"])
+        if "chunk_bytes" in boot:
+            cfg.bootstrap.chunk_bytes = int(boot["chunk_bytes"])
+        if "chunk_retries" in boot:
+            cfg.bootstrap.chunk_retries = int(boot["chunk_retries"])
+        if not 4096 <= cfg.bootstrap.chunk_bytes <= 262144:
+            raise ValueError(
+                "[bootstrap] chunk_bytes must be in [4096, 262144], got "
+                f"{cfg.bootstrap.chunk_bytes}"
+            )
+        if cfg.bootstrap.chunk_retries < 1:
+            raise ValueError(
+                "[bootstrap] chunk_retries must be >= 1, got "
+                f"{cfg.bootstrap.chunk_retries}"
             )
         cfg.replication.resolve_env()
         return cfg

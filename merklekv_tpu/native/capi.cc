@@ -432,7 +432,11 @@ void mkv_server_set_cluster_cb(void* h, mkv_cluster_cb cb, void* ctx) {
     return;
   }
   hs->server->set_cluster_callback([cb, ctx](const std::string& line) {
-    std::vector<char> buf(64 * 1024);
+    // Sized for the largest cluster responses: a SNAPCHUNK frame (up to
+    // 256 KiB raw -> ~350 KiB compressed+base64 worst case) and a
+    // max-frontier TREELEVEL run; allocated per callback call, off the
+    // data hot path.
+    std::vector<char> buf(512 * 1024);
     int n = cb(ctx, line.c_str(), buf.data(), int(buf.size()));
     if (n <= 0) return std::string();
     return std::string(buf.data(), size_t(std::min(n, int(buf.size()))));
@@ -447,6 +451,16 @@ void mkv_server_enable_events(void* h, int on) {
 // bench.py A/B-measure the metrics plane's hot-path overhead.
 void mkv_server_enable_latency(void* h, int on) {
   static_cast<ServerHandle*>(h)->server->set_latency_enabled(on != 0);
+}
+
+// Bootstrap read gate: while off, data-plane reads and anti-entropy
+// serving verbs answer "ERROR LOADING ..." (see Server::set_serving).
+void mkv_server_set_serving(void* h, int on) {
+  static_cast<ServerHandle*>(h)->server->set_serving(on != 0);
+}
+
+int mkv_server_serving(void* h) {
+  return static_cast<ServerHandle*>(h)->server->serving() ? 1 : 0;
 }
 
 // Drain up to max_events change events. Serialization per event: u8 op,

@@ -167,7 +167,7 @@ ParseResult parse_command(const std::string& line) {
     Command c;
     if (u == "GET" || u == "SET" || u == "DELETE" || u == "DEL" ||
         u == "ECHO" || u == "EXISTS" || u == "SYNC" || u == "REPLICATE" ||
-        u == "HASHPAGE" || u == "TREELEVEL") {
+        u == "HASHPAGE" || u == "TREELEVEL" || u == "SNAPCHUNK") {
       return err(u + " command requires arguments");
     }
     if (u == "TRUNCATE") { c.verb = Verb::Truncate; return ok(std::move(c)); }
@@ -180,6 +180,7 @@ ParseResult parse_command(const std::string& line) {
     if (u == "HASH") { c.verb = Verb::Hash; return ok(std::move(c)); }
     if (u == "LEAFHASHES") { c.verb = Verb::LeafHashes; return ok(std::move(c)); }
     if (u == "PEERS") { c.verb = Verb::Peers; return ok(std::move(c)); }
+    if (u == "SNAPMETA") { c.verb = Verb::SnapMeta; return ok(std::move(c)); }
     if (u == "METRICS") { c.verb = Verb::Metrics; return ok(std::move(c)); }
     if (u == "TRACE") {
       c.verb = Verb::Trace;
@@ -401,6 +402,40 @@ ParseResult parse_command(const std::string& line) {
     c.level = level;
     c.lo = lo;
     c.hi = hi;
+    return ok(std::move(c));
+  }
+  if (u == "SNAPMETA") {
+    if (!rest.empty()) {
+      return err("SNAPMETA command does not accept any arguments");
+    }
+    Command c;
+    c.verb = Verb::SnapMeta;
+    return ok(std::move(c));
+  }
+  if (u == "SNAPCHUNK") {
+    // "SNAPCHUNK <seq> <offset> <count>" — one CRC-framed byte range of
+    // the advertised snapshot file. The seq pins the exact file so a
+    // donor-side compaction between chunks can never switch artifacts
+    // under a transfer.
+    auto toks = split_ws(rest);
+    if (toks.size() != 3) {
+      return err("SNAPCHUNK requires arguments: <seq> <offset> <count>");
+    }
+    int64_t seq, off, cnt;
+    if (!parse_i64_str(toks[0], &seq) || seq < 0) {
+      return err("SNAPCHUNK seq must be a non-negative integer");
+    }
+    if (!parse_i64_str(toks[1], &off) || off < 0) {
+      return err("SNAPCHUNK offset must be a non-negative integer");
+    }
+    if (!parse_i64_str(toks[2], &cnt) || cnt <= 0) {
+      return err("SNAPCHUNK count must be a positive integer");
+    }
+    Command c;
+    c.verb = Verb::SnapChunk;
+    c.snap_seq = seq;
+    c.snap_off = off;
+    c.snap_cnt = cnt;
     return ok(std::move(c));
   }
   if (u == "TRACE") {

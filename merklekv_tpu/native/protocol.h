@@ -41,6 +41,15 @@ enum class Verb {
   // control plane's correlated-trace ring buffer (per-peer bytes/rounds/
   // repairs/outcome per cycle). Without a cluster plane: "TRACES 0" + END.
   Trace,
+  // Snapshot shipping (node bootstrap): "SNAPMETA" advertises the donor's
+  // newest Merkle-stamped snapshot (seq, wal_seq, byte size, stamped root);
+  // "SNAPCHUNK <seq> <offset> <count>" streams a CRC-framed byte range of
+  // that snapshot file. Both delegate to the cluster control plane; a node
+  // without durable storage answers ERROR — the capability-fallback signal
+  // (same discipline as TREELEVEL) that degrades a joiner to the plain
+  // anti-entropy walk.
+  SnapMeta,
+  SnapChunk,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };
@@ -56,6 +65,7 @@ struct Command {
   std::string prefix;              // Scan / LeafHashes; HashPage after-cursor
   std::optional<std::string> upto;     // HashPage exclusive upper bound
   int64_t level = 0, lo = 0, hi = 0;   // TreeLevel
+  int64_t snap_seq = 0, snap_off = 0, snap_cnt = 0;  // SnapChunk
   std::optional<std::string> pattern;  // Hash
   std::string host;                // Sync
   uint16_t port = 0;               // Sync

@@ -249,6 +249,29 @@ void Server::stage_event(ChangeOp op, const std::string& key,
 }
 
 std::string Server::dispatch(const Command& cmd, bool* close_conn) {
+  if (!serving_.load(std::memory_order_acquire)) {
+    // Bootstrap gate: no read serves before the shipped snapshot's stamped
+    // root VERIFIES (cluster/bootstrap.py flips the gate). Blocking the
+    // anti-entropy verbs too keeps a peer's pairwise walk from mirroring
+    // this node's half-loaded keyspace as deletions; writes and the
+    // management plane (PING probes, STATS, REPLICATE) stay open.
+    switch (cmd.verb) {
+      case Verb::Get:
+      case Verb::MultiGet:
+      case Verb::Scan:
+      case Verb::Exists:
+      case Verb::Dbsize:
+      case Verb::Hash:
+      case Verb::LeafHashes:
+      case Verb::HashPage:
+      case Verb::TreeLevel:
+      case Verb::SnapMeta:
+      case Verb::SnapChunk:
+        return "ERROR LOADING bootstrap in progress\r\n";
+      default:
+        break;
+    }
+  }
   switch (cmd.verb) {
     case Verb::Get: {
       auto v = engine_->get(cmd.key);
@@ -347,6 +370,30 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         if (!resp.empty()) return resp;
       }
       return "TRACES 0\r\nEND\r\n";
+    }
+    case Verb::SnapMeta:
+    case Verb::SnapChunk: {
+      // Snapshot shipping is served by the control plane (it owns the
+      // durable store and retention pinning); a node without one answers
+      // ERROR — the capability signal that sends a joiner to the plain
+      // anti-entropy walk, exactly like a TREELEVEL-less peer degrades a
+      // bisection walk to paging.
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string line =
+            cmd.verb == Verb::SnapMeta
+                ? std::string("SNAPMETA")
+                : "SNAPCHUNK " + std::to_string(cmd.snap_seq) + " " +
+                      std::to_string(cmd.snap_off) + " " +
+                      std::to_string(cmd.snap_cnt);
+        std::string resp = cb(line);
+        if (!resp.empty()) return resp;
+      }
+      return "ERROR snapshot shipping unavailable\r\n";
     }
     case Verb::Sync:
     case Verb::Replicate: {

@@ -84,6 +84,19 @@ class Server {
   void set_latency_enabled(bool on) {
     latency_enabled_.store(on, std::memory_order_release);
   }
+  // Read-serving gate for node bootstrap: while off, data-plane reads and
+  // anti-entropy serving verbs (GET/MGET/SCAN/EXISTS/DBSIZE/HASH/
+  // LEAFHASHES/HASHPAGE/TREELEVEL/SNAPMETA/SNAPCHUNK) answer
+  // "ERROR LOADING ..." — a bootstrapping node must not serve unverified
+  // state to clients, nor a partial keyspace to a peer's walk (a pairwise
+  // sync against a half-loaded replica would mirror its absences as
+  // deletions). Writes, PING, STATS and the cluster-management verbs stay
+  // available: writes are safe under LWW (the verified snapshot installs
+  // through set_if_newer and never clobbers newer local state).
+  void set_serving(bool on) {
+    serving_.store(on, std::memory_order_release);
+  }
+  bool serving() const { return serving_.load(std::memory_order_acquire); }
 
  private:
   void accept_loop();
@@ -103,6 +116,7 @@ class Server {
   EventQueue events_;
   std::atomic<bool> events_enabled_{false};
   std::atomic<bool> latency_enabled_{true};
+  std::atomic<bool> serving_{true};
   static constexpr size_t kWriteStripes = 64;
   std::mutex write_stripes_[kWriteStripes];
   std::atomic<int> listen_fd_{-1};

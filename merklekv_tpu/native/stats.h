@@ -116,7 +116,9 @@ struct ServerStats {
       case Verb::Peers: management_commands++; break;
       case Verb::Metrics: management_commands++; break;
       case Verb::Trace: management_commands++; break;
-      case Verb::Sync: sync_commands++; break;
+      case Verb::Sync:
+      case Verb::SnapMeta:
+      case Verb::SnapChunk: sync_commands++; break;
       case Verb::Hash:
       case Verb::LeafHashes:
       case Verb::HashPage:

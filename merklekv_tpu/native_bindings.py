@@ -166,6 +166,8 @@ def _load() -> ctypes.CDLL:
     ]
     lib.mkv_server_enable_events.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mkv_server_enable_latency.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mkv_server_set_serving.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mkv_server_serving.argtypes = [ctypes.c_void_p]
     lib.mkv_server_drain_events.argtypes = [
         ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
     ]
@@ -575,6 +577,21 @@ class NativeServer:
         """Toggle the native command-latency histogram (on by default);
         bench.py flips it off to A/B the metrics plane's hot-path cost."""
         self._lib.mkv_server_enable_latency(self._h, 1 if on else 0)
+
+    def set_serving(self, on: bool = True) -> None:
+        """Bootstrap read gate: while off, data-plane reads and the
+        anti-entropy serving verbs answer ``ERROR LOADING ...`` — a
+        bootstrapping node serves zero reads before its shipped snapshot
+        verifies (cluster/bootstrap.py flips this). Writes, PING and the
+        management verbs stay available."""
+        if self._h:
+            self._lib.mkv_server_set_serving(self._h, 1 if on else 0)
+
+    @property
+    def serving(self) -> bool:
+        if not self._h:
+            return False
+        return bool(self._lib.mkv_server_serving(self._h))
 
     def drain_events(self, max_events: int = 0) -> list[ChangeEventRaw]:
         out = ctypes.c_void_p()

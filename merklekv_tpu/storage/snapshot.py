@@ -47,7 +47,9 @@ __all__ = [
     "compute_root_hex",
     "write_snapshot",
     "read_snapshot",
+    "parse_snapshot_bytes",
     "read_snapshot_wal_seq",
+    "read_snapshot_header",
     "verify_snapshot",
     "list_snapshots",
     "snapshot_path",
@@ -210,16 +212,24 @@ def read_snapshot_wal_seq(path: str) -> int:
     """Header-only read of the replay cutoff. Retention runs on every
     compaction and needs just this u64 — decoding + CRC-checking the whole
     body there would cost O(keyspace) I/O per compaction."""
+    return read_snapshot_header(path)[0]
+
+
+def read_snapshot_header(path: str) -> tuple[int, str, int, int]:
+    """Header-only ``(wal_seq, root_hex, n_items, n_tombs)``. The snapshot
+    donor answers SNAPMETA from this — advertising a snapshot must not cost
+    an O(keyspace) decode; the JOINER verifies the stamp against the bytes
+    it actually fetched."""
     with open(path, "rb") as f:
         hdr = f.read(_HDR.size)
     if len(hdr) < _HDR.size:
         raise SnapshotCorruptError(f"{path}: short header")
-    magic, version, wal_seq, _root, _ni, _nt = _HDR.unpack(hdr)
+    magic, version, wal_seq, root, n_items, n_tombs = _HDR.unpack(hdr)
     if magic != SNAPSHOT_MAGIC:
         raise SnapshotCorruptError(f"{path}: bad magic {magic!r}")
     if version != 1:
         raise SnapshotCorruptError(f"{path}: unsupported version {version}")
-    return wal_seq
+    return wal_seq, root.hex(), n_items, n_tombs
 
 
 def read_snapshot(path: str) -> Snapshot:
@@ -228,6 +238,14 @@ def read_snapshot(path: str) -> Snapshot:
     ``root_hex`` so verification covers the bytes actually loaded."""
     with open(path, "rb") as f:
         blob = f.read()
+    return parse_snapshot_bytes(blob, path)
+
+
+def parse_snapshot_bytes(blob: bytes, path: str = "<bytes>") -> Snapshot:
+    """Decode + CRC-check a snapshot from in-memory bytes — the shape a
+    bootstrapping joiner holds after assembling SNAPCHUNK ranges (the file
+    never touches the joiner's disk before its stamp verifies). ``path``
+    only labels error messages."""
     if len(blob) < _HDR.size + _U32.size:
         raise SnapshotCorruptError(f"{path}: short file ({len(blob)} bytes)")
     body, (crc,) = blob[:-4], _U32.unpack(blob[-4:])

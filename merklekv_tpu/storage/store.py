@@ -139,6 +139,14 @@ class DurableStore:
         self._ticker_thread: Optional[threading.Thread] = None
         self._bytes_since_snapshot = 0
         self._snap_mu = threading.Lock()
+        # Snapshot-shipping pins: seq -> last donor access (monotonic).
+        # Retention keeps a pinned snapshot alive while a joiner is still
+        # fetching chunks from it, so a compaction mid-transfer can't
+        # delete the artifact out from under the reader; pins expire after
+        # _PIN_TTL_S of silence (a joiner that died mid-fetch must not pin
+        # disk forever).
+        self._pin_mu = threading.Lock()
+        self._pins: dict[int, float] = {}
         # Set when a TRUNCATE was journaled: the WAL interleaves several
         # append paths (event drain, repair hooks, replication applies), so
         # a frame journaled just before the TRUNCATE frame may have been
@@ -513,18 +521,25 @@ class DurableStore:
         return path
 
     def _apply_retention(self) -> None:
-        """Keep the newest ``snapshots_retained`` snapshots; drop WAL
-        segments older than the oldest retained snapshot's cutoff (the
-        oldest snapshot must still be able to replay forward — that is the
-        repair path's fallback when the newest snapshot fails verify)."""
+        """Keep the newest ``snapshots_retained`` snapshots (plus any the
+        snapshot-shipping donor path has pinned for an in-flight transfer);
+        drop WAL segments older than the oldest retained snapshot's cutoff
+        (the oldest snapshot must still be able to replay forward — that is
+        the repair path's fallback when the newest snapshot fails
+        verify)."""
         keep = max(1, self._cfg.snapshots_retained)
+        pinned = self._live_pins()
         snaps = snapmod.list_snapshots(self._dir)
-        for _, path in snaps[:-keep]:
+        for seq, path in snaps[:-keep]:
+            if seq in pinned:
+                continue  # a joiner is mid-transfer on this artifact
             try:
                 os.unlink(path)
             except OSError:
                 pass
-        retained = snaps[-keep:]
+        retained = snaps[-keep:] + [
+            (seq, path) for seq, path in snaps[:-keep] if seq in pinned
+        ]
         if not retained:
             return
         min_seq = None
@@ -541,6 +556,104 @@ class DurableStore:
                     os.unlink(path)
                 except OSError:
                     pass
+
+    # -- snapshot shipping (donor side) ----------------------------------------
+    # A pin goes stale after this much donor-side silence; every SNAPMETA/
+    # SNAPCHUNK refreshes it, so any live transfer (even over a throttled
+    # link) keeps its artifact alive while a dead joiner releases it.
+    _PIN_TTL_S = 120.0
+    # Donor-side clamp on one SNAPCHUNK's raw range: the compressed+base64
+    # response must fit the native cluster-callback buffer (512 KiB) with
+    # worst-case-incompressible payloads.
+    MAX_CHUNK_BYTES = 256 * 1024
+
+    def _live_pins(self) -> set[int]:
+        now = time.monotonic()
+        with self._pin_mu:
+            for seq in [
+                s
+                for s, t in self._pins.items()
+                if now - t > self._PIN_TTL_S
+            ]:
+                del self._pins[seq]
+            return set(self._pins)
+
+    def _pin(self, seq: int) -> None:
+        with self._pin_mu:
+            self._pins[seq] = time.monotonic()
+
+    # donor_meta sentinel: no artifact yet, but one is being built in the
+    # background — the joiner should retry shortly instead of degrading.
+    BUILDING = "building"
+
+    def donor_meta(self):
+        """Advertise the newest shippable snapshot: ``(seq, wal_seq,
+        size_bytes, root_hex)``, pinning it against retention. Returns
+        :data:`BUILDING` when no artifact exists yet but the background
+        ticker has been asked to write one (the SNAPMETA handler must not
+        block a request thread on an O(keyspace) snapshot write — at the
+        10M-key target that outlives the joiner's op timeout and cascades
+        a useless full snapshot onto every donor it fails over to), or
+        None when no snapshot can be produced at all (recovery not run,
+        write failure)."""
+        snaps = snapmod.list_snapshots(self._dir)
+        stale = False
+        if snaps and self._writer is not None:
+            # Freshness: when the WAL delta since the last snapshot rivals
+            # the snapshot itself, shipping the old artifact would push the
+            # bulk of the keyspace through the joiner's delta walk anyway —
+            # ask for a re-snapshot so the NEXT transfer carries the
+            # savings, and serve the current artifact meanwhile.
+            try:
+                size_now = os.path.getsize(snaps[-1][1])
+            except OSError:
+                size_now = 0
+            stale = self._bytes_since_snapshot >= max(size_now, 1 << 20)
+        if not snaps or stale:
+            if self._writer is None:
+                return None
+            if self._ticker_thread is not None:
+                # Background build; a missing artifact answers BUILDING
+                # (joiner polls), a merely-stale one ships as-is below.
+                self._snapshot_requested = True
+                if not snaps:
+                    return self.BUILDING
+            else:
+                # No ticker (embedded/test shape): inline is the only way
+                # an artifact ever materializes.
+                try:
+                    self.snapshot_now()
+                except Exception:
+                    get_metrics().inc("storage.donor_meta_errors")
+                    if not snaps:
+                        return None
+                snaps = snapmod.list_snapshots(self._dir)
+                if not snaps:
+                    return None
+        seq, path = snaps[-1]
+        try:
+            wal_seq, root_hex, _ni, _nt = snapmod.read_snapshot_header(path)
+            size = os.path.getsize(path)
+        except (OSError, SnapshotCorruptError):
+            get_metrics().inc("storage.donor_meta_errors")
+            return None
+        self._pin(seq)
+        return seq, wal_seq, size, root_hex
+
+    def read_snapshot_range(self, seq: int, offset: int, count: int) -> bytes:
+        """One raw byte range of snapshot ``seq`` for SNAPCHUNK, refreshing
+        its retention pin. Raises FileNotFoundError when the artifact is
+        gone (donor restarted past the pin TTL) — the joiner re-discovers.
+        Short reads at EOF return the remaining bytes; ``offset`` past EOF
+        returns b"" (the joiner treats that as transfer-size disagreement
+        and re-discovers rather than assembling a short file)."""
+        count = max(0, min(count, self.MAX_CHUNK_BYTES))
+        path = snapmod.snapshot_path(self._dir, seq)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            raw = f.read(count)
+        self._pin(seq)
+        return raw
 
     # -- shutdown --------------------------------------------------------------
     def stop(self) -> None:

@@ -65,6 +65,12 @@ class FaultSpec:
     truncate_rate: float = 0.0
     # Forward the chunk intact, then kill the connection.
     close_rate: float = 0.0
+    # Token-bucket bandwidth cap (bytes/second, 0 = unlimited) for this
+    # direction: each forwarded chunk spends its size in tokens, the bucket
+    # refills at the rate with one rate-second of burst — a slow WAN link /
+    # throttled middlebox, the fault snapshot-shipping resume must survive
+    # realistically (not just drop/truncate).
+    bandwidth_bytes_per_s: float = 0.0
 
 
 class FaultInjector:
@@ -112,6 +118,7 @@ class FaultInjector:
         self.chunks_duplicated = 0
         self.chunks_reordered = 0
         self.chunks_truncated = 0
+        self.chunks_throttled = 0
         self.kills = 0
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -208,6 +215,10 @@ class FaultInjector:
         rng: random.Random,
     ) -> None:
         held: Optional[bytes] = None  # chunk delayed for a pairwise swap
+        # Token bucket for the bandwidth cap: thread-local — one pump
+        # thread owns one (connection, direction) stream.
+        tokens = 0.0
+        refill_at = time.monotonic()
         try:
             while not self._closed:
                 try:
@@ -217,6 +228,24 @@ class FaultInjector:
                 if not data:
                     break
                 spec = self._specs[direction]
+                if spec.bandwidth_bytes_per_s > 0:
+                    rate = spec.bandwidth_bytes_per_s
+                    now = time.monotonic()
+                    # Burst capacity: one rate-second, but never less than
+                    # a chunk (a cap below the chunk size must still pass
+                    # whole chunks, just slowly).
+                    cap = max(rate, float(len(data)))
+                    tokens = min(cap, tokens + (now - refill_at) * rate)
+                    refill_at = now
+                    if tokens < len(data):
+                        # Only chunks that actually wait count as throttled.
+                        self.chunks_throttled += 1
+                    while tokens < len(data) and not self._closed:
+                        time.sleep(min((len(data) - tokens) / rate, 0.05))
+                        now = time.monotonic()
+                        tokens = min(cap, tokens + (now - refill_at) * rate)
+                        refill_at = now
+                    tokens -= len(data)
                 budget = self._kill_budget[direction]
                 if budget is not None and self._forwarded[direction] >= budget:
                     self.kill_peer()
@@ -270,11 +299,25 @@ class FaultInjector:
 
     @staticmethod
     def _hard_close(sock: socket.socket) -> None:
-        # RST, not FIN: a killed peer does not say goodbye.
+        # Abortive teardown, delivered PROMPTLY. SO_LINGER(1,0) arms an
+        # RST-on-close, but a bare close() is deferred by the kernel while
+        # a pump thread sits parked in recv() on this fd (the blocked recv
+        # holds a reference) — the far end then never sees the death and
+        # hangs for its full socket timeout instead of failing fast. The
+        # shutdown() tears the connection down immediately regardless of
+        # who is blocked on it, at the cost of leading with a FIN: the far
+        # end observes EOF-or-reset rather than a guaranteed bare RST.
+        # Client stacks here surface both identically (ConnectionError),
+        # and a death the victim actually notices beats a textbook RST it
+        # waits 30 s to discover.
         try:
             sock.setsockopt(
                 socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
             )
+        except OSError:
+            pass
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
         try:

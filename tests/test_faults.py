@@ -458,3 +458,55 @@ def test_soak_full_fault_mix(make_pair, seed):
     )
     p.sync_until_converged(max_cycles=120)
     assert snapshot(p.local) == snapshot(p.remote)
+
+
+def test_bandwidth_throttle_paces_stream():
+    """The token-bucket bandwidth fault: a capped direction delivers at
+    most rate bytes/s (plus one burst allowance) — the slow-WAN shape the
+    snapshot-shipping resume tests lean on."""
+    import socket as _socket
+    import threading as _threading
+
+    sink = _socket.socket()
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)
+    received = {"n": 0}
+    done = _threading.Event()
+
+    def drain():
+        conn, _ = sink.accept()
+        try:
+            while True:
+                # The proxy hard-closes with RST once the client side goes
+                # away; everything forwarded before that still counts.
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                received["n"] += len(chunk)
+        except OSError:
+            pass
+        conn.close()
+        done.set()
+
+    _threading.Thread(target=drain, daemon=True).start()
+    inj = FaultInjector("127.0.0.1", sink.getsockname()[1], seed=5)
+    inj.set_faults("c2s", bandwidth_bytes_per_s=32 * 1024)
+    try:
+        payload = b"x" * (96 * 1024)
+        t0 = time.perf_counter()
+        s = _socket.create_connection((inj.host, inj.port))
+        s.sendall(payload)
+        # Half-close: EOF reaches the proxy only after it has drained (and
+        # throttled) everything we sent; a full close could RST the stream
+        # out from under the pacing loop.
+        s.shutdown(_socket.SHUT_WR)
+        assert done.wait(timeout=20)
+        elapsed = time.perf_counter() - t0
+        s.close()
+        assert received["n"] == len(payload)
+        # 96 KiB at 32 KiB/s with a 32 KiB burst: >= ~2 s on the wire.
+        assert elapsed >= 1.5, f"throttle did not pace: {elapsed:.2f}s"
+        assert inj.chunks_throttled > 0
+    finally:
+        inj.close()
+        sink.close()
