@@ -518,6 +518,15 @@ def bench_replicated_write_throughput(n_events: int) -> dict:
     m = get_metrics()
     hist = m.histogram("replicator.batch_size").snapshot()
     counters = m.snapshot()["counters"]
+    # Convergence-lag plane evidence: write-origin -> applied-on-replica
+    # delay (per applied frame; envelope HWMs drive it — obs/lag.py).
+    conv = m.histogram("replication.convergence")
+    conv_snap = conv.snapshot()
+
+    def q_ms(q: float):
+        v = conv.quantile(q)
+        return None if v is None else round(v * 1e3, 3)
+
     return {
         "metric": "replicated_write_throughput",
         "value": round(batched_rate, 1),
@@ -533,6 +542,12 @@ def bench_replicated_write_throughput(n_events: int) -> dict:
             "bucket_le_2toi_events": hist["counts"],
             "frames": hist["count"],
             "events": int(round(hist["sum"] * 1e6)),
+        },
+        "convergence": {
+            "frames": conv_snap["count"],
+            "p50_ms": q_ms(0.5),
+            "p99_ms": q_ms(0.99),
+            "max_ms": round(conv_snap["max"] * 1e3, 3),
         },
         "target": 5.0,
         "target_met": batched_rate / max(per_event_rate, 1e-9) >= 5.0,

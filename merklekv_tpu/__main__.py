@@ -30,6 +30,13 @@ def main(argv: list[str] | None = None) -> int:
         from merklekv_tpu.obs.top import main as top_main
 
         return top_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # Cross-node causal-trace assembly: TRACEDUMP from every node,
+        # stitched into one Perfetto-loadable Chrome trace
+        # (docs/OBSERVABILITY.md "Causal tracing").
+        from merklekv_tpu.obs.tracewire import main as trace_main
+
+        return trace_main(argv[1:])
 
     p = argparse.ArgumentParser(prog="merklekv_tpu")
     p.add_argument("--config", help="TOML config file")

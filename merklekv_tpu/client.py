@@ -124,6 +124,24 @@ def _count_after(resp: str, prefix: str) -> int:
     return int(resp[len(prefix):])
 
 
+# Error-text signatures of a peer that cannot parse a trailing trace-context
+# token (pre-tracing version): its parser rejects the extra argument with
+# one of these arity complaints. The client then drops the token for the
+# connection's lifetime and retries — capability fallback, so traced
+# initiators interop with untraced peers. The ERROR answer is a single
+# line, so the stream stays in sync across the retry.
+_TRACE_CAPABILITY_ERRORS = (
+    "requires arguments",
+    "does not accept any arguments",
+    "accepts only one argument",
+    "Unknown command",
+)
+
+
+def _is_trace_capability_error(msg: str) -> bool:
+    return any(sig in msg for sig in _TRACE_CAPABILITY_ERRORS)
+
+
 class _ResponseReader:
     """Incremental CRLF line splitter over a byte stream."""
 
@@ -167,6 +185,13 @@ class MerkleKVClient:
         # number the bisection walk exists to shrink.
         self.bytes_sent = 0
         self.bytes_received = 0
+        # Causal-trace propagation: when set (a zero-arg callable returning
+        # the active tc= token or None — usually tracewire.current_token),
+        # cluster verbs append the token so the peer's serve spans stitch
+        # into the caller's trace. None/False tri-state records whether the
+        # peer accepted a token; False = capability fallback engaged.
+        self.trace_provider = None
+        self._peer_traced: Optional[bool] = None
 
     # -- lifecycle ---------------------------------------------------------
     def connect(self) -> "MerkleKVClient":
@@ -232,6 +257,37 @@ class MerkleKVClient:
     def _request(self, line: str) -> str:
         self._send_line(line)
         return self._read_line()
+
+    def _trace_token(self) -> Optional[str]:
+        if self.trace_provider is None or self._peer_traced is False:
+            return None
+        try:
+            return self.trace_provider()
+        except Exception:
+            return None  # a broken provider must never fail the request
+
+    def _traced_request(self, line: str, require_settled: bool = False) -> str:
+        """Send a cluster verb with the active trace token appended; on an
+        arity ERROR (peer predates trace propagation) drop the token for
+        this connection and retry the plain form once.
+
+        ``require_settled``: only attach the token once this connection
+        has PROVED the peer parses it (an earlier traced verb succeeded).
+        Verbs with OPTIONAL trailing arguments need this — an old peer
+        reads the token as that argument (LEAFHASHES: a prefix -> empty
+        hash set; HASHPAGE: the after-cursor -> a silently truncated
+        page) instead of erroring. Fixed-arity verbs (TREELEVEL,
+        SNAPMETA, SNAPCHUNK) fail closed on the extra token and settle
+        capability safely."""
+        tok = self._trace_token()
+        if tok is None or (require_settled and self._peer_traced is not True):
+            return self._request(line)
+        resp = self._request(f"{line} {tok}")
+        if resp.startswith("ERROR ") and _is_trace_capability_error(resp):
+            self._peer_traced = False
+            return self._request(line)
+        self._peer_traced = True
+        return resp
 
     def _read_body(self, n: int) -> list[str]:
         return [self._read_line() for _ in range(n)]
@@ -333,7 +389,9 @@ class MerkleKVClient:
         field "-"). Servers that predate the ts field yield ts 0
         ("unknown age")."""
         cmd = f"LEAFHASHES {prefix}" if prefix else "LEAFHASHES"
-        n = _count_after(self._request(cmd), "HASHES ")
+        n = _count_after(
+            self._traced_request(cmd, require_settled=True), "HASHES "
+        )
         out: dict[str, tuple[Optional[str], int]] = {}
         for _ in range(n):
             parts = self._read_line().split(" ")
@@ -369,7 +427,12 @@ class MerkleKVClient:
             cmd = f"HASHPAGE {count} {after}"
         else:
             cmd = f"HASHPAGE {count}"
-        n = _count_after(self._request(cmd), "HASHES ")
+        # require_settled: an old peer would read the token as the
+        # after-cursor (or upto bound) and silently skip every key below
+        # it — a fail-OPEN page truncation, never an ERROR.
+        n = _count_after(
+            self._traced_request(cmd, require_settled=True), "HASHES "
+        )
         rows: list[tuple[str, Optional[str], int]] = []
         for _ in range(n):
             parts = self._read_line().split(" ")
@@ -403,7 +466,9 @@ class MerkleKVClient:
         ``m_0 = n``, ``m_{l+1} = (m_l + 1) // 2``). ``lo == hi`` is the
         zero-cost capability probe + leaf-count fetch the bisection walk
         opens with."""
-        resp = _parse_simple(self._request(f"TREELEVEL {level} {lo} {hi}"))
+        resp = _parse_simple(
+            self._traced_request(f"TREELEVEL {level} {lo} {hi}")
+        )
         if not resp.startswith("NODES "):
             raise ProtocolError(f"unexpected response: {resp}")
         try:
@@ -433,7 +498,7 @@ class MerkleKVClient:
         or an old-version peer without the verb — answers ERROR, raised
         here as ProtocolError: the joiner's capability-fallback signal to
         degrade to the plain anti-entropy walk."""
-        return _parse_snapmeta(_parse_simple(self._request("SNAPMETA")))
+        return _parse_snapmeta(_parse_simple(self._traced_request("SNAPMETA")))
 
     def snap_chunk(self, seq: int, offset: int, count: int) -> bytes:
         """One verified byte range of snapshot ``seq`` (SNAPCHUNK): the
@@ -443,7 +508,7 @@ class MerkleKVClient:
         :class:`ChunkIntegrityError` — the caller retries the offset, and
         a partial/corrupt frame can never be applied."""
         resp = _parse_simple(
-            self._request(f"SNAPCHUNK {seq} {offset} {count}")
+            self._traced_request(f"SNAPCHUNK {seq} {offset} {count}")
         )
         off, rawlen, crc = _parse_chunk_header(resp)
         payload = self._read_line()
@@ -551,6 +616,26 @@ class MerkleKVClient:
             raise ProtocolError(f"unexpected response: {resp}")
         return self._read_field_table()
 
+    def trace_dump(self, n: int = 0) -> list[dict[str, str]]:
+        """Raw causal-trace spans (TRACEDUMP extension verb): the newest
+        ``n`` spans (0 = all) from the node's span collector, one dict per
+        span with trace/span/parent/name/role/ts_ns/dur_ns/node fields —
+        the stitching input ``obs/tracewire.py`` assembles into a Chrome
+        trace. Empty on a node without a cluster plane."""
+        resp = _parse_simple(self._request(f"TRACEDUMP {n}"))
+        if not resp.startswith("SPANS "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_field_table()
+
+    def profile(self, seconds: int) -> str:
+        """Start a bounded device-profiler capture (PROFILE extension
+        verb); returns the capture directory on the serving node. Raises
+        ProtocolError when the node has no device plane / profiler."""
+        resp = _parse_simple(self._request(f"PROFILE {seconds}"))
+        if not resp.startswith("PROFILE "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return resp[8:]
+
     def flushdb(self) -> bool:
         return _parse_simple(self._request("FLUSHDB")) == "OK"
 
@@ -603,6 +688,9 @@ class AsyncMerkleKVClient:
         # Wire-byte accounting, mirroring the sync client.
         self.bytes_sent = 0
         self.bytes_received = 0
+        # Causal-trace propagation, mirroring the sync client.
+        self.trace_provider = None
+        self._peer_traced: Optional[bool] = None
 
     async def connect(self) -> "AsyncMerkleKVClient":
         try:
@@ -656,6 +744,30 @@ class AsyncMerkleKVClient:
         self.bytes_received += len(raw)
         return raw.rstrip(b"\r\n").decode("utf-8", "surrogateescape")
 
+    def _trace_token(self) -> Optional[str]:
+        if self.trace_provider is None or self._peer_traced is False:
+            return None
+        try:
+            return self.trace_provider()
+        except Exception:
+            return None
+
+    async def _traced_request(
+        self, line: str, require_settled: bool = False
+    ) -> str:
+        """Async twin of the sync client's ``_traced_request``: same token
+        append, same capability fallback on an arity ERROR, same
+        settled-capability rule for optional-trailing-argument verbs."""
+        tok = self._trace_token()
+        if tok is None or (require_settled and self._peer_traced is not True):
+            return await self._request(line)
+        resp = await self._request(f"{line} {tok}")
+        if resp.startswith("ERROR ") and _is_trace_capability_error(resp):
+            self._peer_traced = False
+            return await self._request(line)
+        self._peer_traced = True
+        return resp
+
     async def get(self, key: str) -> Optional[str]:
         return _parse_value(await self._request(f"GET {key}"))
 
@@ -706,7 +818,9 @@ class AsyncMerkleKVClient:
             cmd = f"HASHPAGE {count} {after}"
         else:
             cmd = f"HASHPAGE {count}"
-        n = _count_after(await self._request(cmd), "HASHES ")
+        n = _count_after(
+            await self._traced_request(cmd, require_settled=True), "HASHES "
+        )
         rows: list[tuple[str, Optional[str], int]] = []
         for _ in range(n):
             parts = (await self._read_line()).split(" ")
@@ -732,7 +846,7 @@ class AsyncMerkleKVClient:
         """Async TREELEVEL — same semantics as the sync client's
         ``tree_level``."""
         resp = _parse_simple(
-            await self._request(f"TREELEVEL {level} {lo} {hi}")
+            await self._traced_request(f"TREELEVEL {level} {lo} {hi}")
         )
         if not resp.startswith("NODES "):
             raise ProtocolError(f"unexpected response: {resp}")
@@ -757,13 +871,15 @@ class AsyncMerkleKVClient:
     async def snap_meta(self) -> tuple[int, int, int, str]:
         """Async SNAPMETA — same semantics as the sync client's
         ``snap_meta``."""
-        return _parse_snapmeta(_parse_simple(await self._request("SNAPMETA")))
+        return _parse_snapmeta(
+            _parse_simple(await self._traced_request("SNAPMETA"))
+        )
 
     async def snap_chunk(self, seq: int, offset: int, count: int) -> bytes:
         """Async SNAPCHUNK — same verify-or-raise semantics as the sync
         client's ``snap_chunk``."""
         resp = _parse_simple(
-            await self._request(f"SNAPCHUNK {seq} {offset} {count}")
+            await self._traced_request(f"SNAPCHUNK {seq} {offset} {count}")
         )
         off, rawlen, crc = _parse_chunk_header(resp)
         payload = await self._read_line()
@@ -812,6 +928,21 @@ class AsyncMerkleKVClient:
         """Async TRACE — same semantics as the sync client's ``trace``."""
         resp = _parse_simple(await self._request(f"TRACE {n}"))
         if not resp.startswith("TRACES "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        rows = []
+        while True:
+            line = await self._read_line()
+            if line == "END":
+                return rows
+            rows.append(
+                dict(f.split("=", 1) for f in line.split(" ") if "=" in f)
+            )
+
+    async def trace_dump(self, n: int = 0) -> list[dict[str, str]]:
+        """Async TRACEDUMP — same semantics as the sync client's
+        ``trace_dump``."""
+        resp = _parse_simple(await self._request(f"TRACEDUMP {n}"))
+        if not resp.startswith("SPANS "):
             raise ProtocolError(f"unexpected response: {resp}")
         rows = []
         while True:

@@ -51,6 +51,7 @@ __all__ = [
     "decode_json",
     "decode_any",
     "decode_events",
+    "decode_events_meta",
 ]
 
 
@@ -191,16 +192,43 @@ def coalesce_events(
     return kept, len(events) - len(kept)
 
 
-def encode_batch_cbor(events: list[ChangeEvent], src: str) -> bytes:
+def encode_batch_cbor(
+    events: list[ChangeEvent],
+    src: str,
+    hwm_seq: Optional[int] = None,
+    hwm_ts: Optional[int] = None,
+    trace: Optional[str] = None,
+) -> bytes:
     """Batch envelope ``{v, src, events: [...]}``: one wire frame for a
     whole drained batch. ``src`` rides on the envelope once; per-event maps
-    omit it (the decoder reinstates it)."""
+    omit it (the decoder reinstates it).
+
+    Optional additive fields (same envelope version — old decoders ignore
+    unknown map keys):
+
+    - ``hseq``/``hts``: the publisher's **publish high-water mark** —
+      cumulative events put on the wire INCLUDING this frame, and the
+      publish wall clock (unix ns). Appliers derive per-peer
+      ``replication.lag_events`` / ``replication.lag_ms`` from them
+      (obs/lag.py).
+    - ``tc``: a causal trace-context token (obs/tracewire.py) so a traced
+      write's replication apply stitches into the originating trace.
+    """
     body = bytearray(_cbor_head(4, len(events)))
     for ev in events:
         body += _event_map_cbor(ev, include_src=False)
-    out = bytearray(_cbor_head(5, 3))
+    extra: list[tuple[bytes, bytes]] = []
+    if hwm_seq is not None:
+        extra.append((b"\x64hseq", _cbor_uint(hwm_seq)))
+    if hwm_ts is not None:
+        extra.append((b"\x63hts", _cbor_uint(hwm_ts)))
+    if trace:
+        extra.append((b"\x62tc", _cbor_text(trace)))
+    out = bytearray(_cbor_head(5, 3 + len(extra)))
     out += b"\x61v" + _cbor_uint(BATCH_ENVELOPE_VERSION)
     out += b"\x63src" + _cbor_text_or_bytes(src)
+    for k, v in extra:
+        out += k + v
     out += b"\x66events" + bytes(body)
     return bytes(out)
 
@@ -432,11 +460,30 @@ def decode_events(data: bytes) -> list[ChangeEvent]:
     Raises ValueError for undecodable frames AND for envelopes of an
     unknown version or malformed shape (a half-understood frame must be
     counted and dropped whole, never partially applied)."""
+    events, _meta = decode_events_meta(data)
+    return events
+
+
+def decode_events_meta(data: bytes) -> tuple[list[ChangeEvent], dict]:
+    """``decode_events`` plus the envelope's additive metadata: ``src``,
+    the publish high-water mark (``hseq``/``hts``) and the causal trace
+    token (``tc``) when present. Legacy single-event payloads yield the
+    event's own ``src`` and no HWM."""
     m = None
     try:
         m = _CborReader(data).item()
     except Exception:
         pass
     if isinstance(m, dict) and "events" in m:
-        return _events_from_envelope(m)
-    return [decode_any(data)]
+        events = _events_from_envelope(m)
+        meta: dict = {"src": _as_key_str(m.get("src", ""))}
+        if isinstance(m.get("hseq"), int):
+            meta["hseq"] = m["hseq"]
+        if isinstance(m.get("hts"), int):
+            meta["hts"] = m["hts"]
+        tc = m.get("tc")
+        if isinstance(tc, str):
+            meta["tc"] = tc
+        return events, meta
+    ev = decode_any(data)
+    return [ev], {"src": ev.src}

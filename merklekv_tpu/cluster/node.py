@@ -8,8 +8,10 @@ anti-entropy loop.
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
+import time
 from typing import Optional
 
 from merklekv_tpu.cluster.replicator import Replicator
@@ -17,6 +19,8 @@ from merklekv_tpu.cluster.sync import SyncManager
 from merklekv_tpu.cluster.transport import Transport, make_transport
 from merklekv_tpu.config import Config
 from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+from merklekv_tpu.obs import tracewire
+from merklekv_tpu.obs.lag import ConvergenceTracker
 
 __all__ = ["ClusterNode"]
 
@@ -45,6 +49,16 @@ class ClusterNode:
         self._bootstrap = None  # BootstrapSession while a (re)join runs
         self._bootstrap_thread: Optional[threading.Thread] = None
         self._stopped = False  # guards late starts from the bootstrap thread
+        # Convergence-lag SLO plane: per-peer lag from envelope publish
+        # HWMs, residue cleared when an anti-entropy cycle converges, and
+        # the /healthz readiness level (live|lagging|diverged).
+        self.lag_tracker = ConvergenceTracker(
+            lag_ms_threshold=cfg.observability.lag_ms_threshold,
+            diverged_after_s=cfg.observability.diverged_after_s,
+        )
+        # One PROFILE capture at a time; directory returned on start.
+        self._profile_mu = threading.Lock()
+        self._profiling = False
         self.sync_manager = SyncManager(
             engine,
             device=cfg.anti_entropy.engine,
@@ -52,6 +66,7 @@ class ClusterNode:
             on_peer_degraded=self._on_peer_degraded,
             mode=cfg.anti_entropy.mode,
             bisect_threshold=cfg.anti_entropy.bisect_threshold,
+            on_cycle_converged=self.lag_tracker.on_converged,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -61,6 +76,10 @@ class ClusterNode:
         from merklekv_tpu.obs.trace import get_trace_buffer
 
         get_trace_buffer().set_capacity(self._cfg.observability.trace_cycles)
+        tracewire.set_propagation(self._cfg.observability.trace_propagation)
+        tracewire.get_collector().set_capacity(
+            self._cfg.observability.trace_spans
+        )
         if self._cfg.observability.http_port != 0:
             # Per-node Prometheus endpoint (/metrics + /healthz): registry
             # counters/histograms/gauges and the native STATS block in one
@@ -211,6 +230,7 @@ class ClusterNode:
                     storage=storage,
                     batch_max_events=self._cfg.replication.batch_max_events,
                     batch_max_bytes=self._cfg.replication.batch_max_bytes,
+                    lag_tracker=self.lag_tracker,
                 )
                 self._replicator.start()
             except Exception as e:
@@ -368,6 +388,86 @@ class ClusterNode:
         m.inc("bootstrap.donor_bytes", len(raw))
         return f"CHUNK {offset} {len(raw)} {zlib.crc32(raw)}\r\n{payload}\r\n"
 
+    # -- causal tracing / profiler --------------------------------------------
+    def _record_trace_span(self, args: list[str]) -> str:
+        """Record one donor-side serve span from a TRACESPAN notification.
+        Malformed notifications are dropped (never an error back into the
+        native dispatch path)."""
+        try:
+            verb, token, start_ns, dur_ns = (
+                args[0], args[1], int(args[2]), int(args[3])
+            )
+        except (IndexError, ValueError):
+            return "OK\r\n"
+        ctx = tracewire.parse_token(token)
+        if ctx is None:
+            return "OK\r\n"
+        tracewire.get_collector().record(
+            trace_id=ctx.trace_id,
+            span_id=tracewire._new_id(),
+            parent_id=ctx.span_id,
+            name=f"serve.{verb.lower()}",
+            role="donor",
+            ts_ns=start_ns,
+            dur_ns=dur_ns,
+            node=f"{self._cfg.host}:{self._server.port}",
+        )
+        return "OK\r\n"
+
+    def _profile_wire(self, secs: int) -> str:
+        """Start a bounded ``jax.profiler`` capture ("PROFILE <secs>"): the
+        device data plane's rebuild/diff/scatter programs land in the
+        capture (inspect with TensorBoard/xprof/Perfetto). The response
+        carries the capture directory immediately; a background thread
+        stops the capture after ``secs``. One capture at a time."""
+        secs = max(1, min(secs, 600))
+        with self._profile_mu:
+            if self._profiling:
+                return "ERROR profile capture already running\r\n"
+            logdir = self._cfg.observability.profile_dir
+            if not logdir:
+                # Config contract: "" = <storage_path>/profiles on a
+                # durable node (captures survive with the data), system
+                # temp on a storage-less one.
+                if self._cfg.storage.enabled:
+                    logdir = os.path.join(
+                        self._cfg.storage_path, "profiles"
+                    )
+                else:
+                    import tempfile
+
+                    logdir = os.path.join(
+                        tempfile.gettempdir(), "mkv-profiles"
+                    )
+            logdir = os.path.join(logdir, time.strftime("%Y%m%d-%H%M%S"))
+            try:
+                os.makedirs(logdir, exist_ok=True)
+                import jax
+
+                jax.profiler.start_trace(logdir)
+            except Exception as e:
+                return f"ERROR profiler unavailable: {e}\r\n"
+            self._profiling = True
+
+        def stop_later() -> None:
+            time.sleep(secs)
+            with self._profile_mu:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._profiling = False
+
+        threading.Thread(
+            target=stop_later, daemon=True, name="mkv-profile-stop"
+        ).start()
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        get_metrics().inc("profiler.captures")
+        return f"PROFILE {logdir}\r\n"
+
     def _on_peer_degraded(self, peer: str, reason: str) -> None:
         """A sync stream against ``peer`` died mid-cycle (its remaining
         repairs are checkpointed for resume); reflect it in the health
@@ -445,10 +545,15 @@ class ClusterNode:
         return self._exporter.port if self._exporter is not None else None
 
     def _health_payload(self) -> dict:
-        """/healthz extra fields: engine reachability + peer summary."""
+        """/healthz extra fields: engine reachability, peer summary, and
+        the convergence-lag readiness level (live|lagging|diverged)."""
         if not self._engine._h:
-            return {"keys": -1}
+            return {"keys": -1, "readiness": "diverged"}
         payload = {"keys": self._engine.dbsize(), "port": self._server.port}
+        payload["readiness"] = self.lag_tracker.readiness()
+        lag = self.lag_tracker.lag_events()
+        if lag:
+            payload["lag_events"] = sum(lag.values())
         h = self._health
         if h is not None:
             rows = h.snapshot()
@@ -503,6 +608,8 @@ class ClusterNode:
             b = self._bootstrap
             return b.state_code() if b is not None else 0
 
+        tracker = self.lag_tracker
+
         gauges = [
             ("keyspace.keys", live_keys,
              "Live keys in the native engine.", ""),
@@ -522,6 +629,15 @@ class ClusterNode:
             ("bootstrap.state", bootstrap_state,
              "Bootstrap state machine (0=idle 1=discover 2=fetch 3=verify "
              "4=delta 5=live -1=failed).", ""),
+            ("replication.lag_events", tracker.lag_events,
+             "Events a peer has published (envelope HWM) that this node "
+             "has not yet applied; anti-entropy convergence clears drop "
+             "residue.", "src"),
+            ("replication.lag_ms", tracker.lag_ms,
+             "Publish-to-apply wall delay of the newest applied frame per "
+             "peer (ms; cross-host clock skew applies).", "src"),
+            ("node.readiness", tracker.readiness_code,
+             "Convergence readiness (2=live 1=lagging 0=diverged).", ""),
         ]
         if self._storage is not None:
             storage = self._storage
@@ -580,6 +696,16 @@ class ClusterNode:
                 v = getattr(t, attr, None)
                 if v is not None:
                     lines.append(f"transport.{attr}_live:{v}")
+        # Convergence-lag plane: per-peer lag gauges + the readiness level,
+        # so wire-only consumers (top) see them without scraping /metrics.
+        # The METRICS contract is integer-text values across the board
+        # (parsers depend on it), so lag_ms rounds and readiness rides as
+        # its numeric code (2=live 1=lagging 0=diverged).
+        for src, v in sorted(self.lag_tracker.lag_events().items()):
+            lines.append(f"replication.lag_events.{src}:{v}")
+        for src, v in sorted(self.lag_tracker.lag_ms().items()):
+            lines.append(f"replication.lag_ms.{src}:{int(round(v))}")
+        lines.append(f"readiness_code:{self.lag_tracker.readiness_code()}")
         body = "".join(f"{ln}\r\n" for ln in lines)
         return f"METRICS\r\n{body}END\r\n"
 
@@ -599,6 +725,18 @@ class ClusterNode:
 
             n = int(parts[1]) if len(parts) > 1 else 8
             return get_trace_buffer().wire_dump(n)
+        if parts[0] == "TRACEDUMP":
+            # Raw causal-trace spans (cross-node stitching input).
+            n = int(parts[1]) if len(parts) > 1 else 0
+            return tracewire.get_collector().wire_dump(n)
+        if parts[0] == "TRACESPAN":
+            # Native server notification: a traced cluster verb was served
+            # on this node. Record the donor-side span under the
+            # initiator's trace id, parented to the span id the token
+            # carried. "TRACESPAN <VERB> <tc=token> <start_ns> <dur_ns>".
+            return self._record_trace_span(parts[1:])
+        if parts[0] == "PROFILE":
+            return self._profile_wire(int(parts[1]))
         if parts[0] == "HASH":
             # Whole-keyspace root served from the device-resident
             # incremental tree; empty answer falls back to the native path.

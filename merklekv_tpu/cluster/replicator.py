@@ -31,6 +31,7 @@ baseline ``bench.py replicated_write_throughput`` A/Bs against).
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Callable, Optional
 
@@ -39,10 +40,11 @@ from merklekv_tpu.cluster.change_event import (
     ChangeEvent,
     OpKind,
     coalesce_events,
-    decode_events,
+    decode_events_meta,
     encode_batch_cbor,
     encode_cbor,
 )
+from merklekv_tpu.obs import tracewire
 from merklekv_tpu.cluster.retry import REPLICATOR_PUBLISH, RetryPolicy
 from merklekv_tpu.cluster.transport import Transport
 from merklekv_tpu.utils.tracing import get_metrics
@@ -97,6 +99,7 @@ class Replicator:
         retry: Optional[RetryPolicy] = None,
         batch_max_events: int = 512,
         batch_max_bytes: int = 1 << 20,
+        lag_tracker=None,  # Optional[obs.lag.ConvergenceTracker]
     ) -> None:
         self._engine = engine
         self._server = server
@@ -152,18 +155,32 @@ class Replicator:
         self.publish_errors = 0
         self.coalesced = 0
         self.buffered = 0
+        # Convergence-lag plane (obs/lag.py): outbound frames carry a
+        # publish HWM (cumulative events put on the wire — counted even for
+        # frames the transport then drops, so a lost frame shows as peer
+        # lag until anti-entropy converges); inbound frames feed the
+        # per-peer lag gauges through this tracker.
+        self._lag = lag_tracker
+        self._pub_seq = 0
         # Bootstrap hold: while set, inbound frames JOURNAL (the WAL must
         # never gap) but defer their engine/mirror apply until the verified
         # snapshot is installed — then they replay in arrival order through
         # the same LWW path, so the write stream has no gap and no
         # unverified state ever serves.
         self._holding = False
-        self._held: list[list[ChangeEvent]] = []
+        self._held: list[tuple[list[ChangeEvent], dict]] = []
+        # ONE pinned bound-method object for subscribe/unsubscribe:
+        # transports remove subscriptions by callback IDENTITY, and
+        # ``self._on_message`` evaluates to a FRESH bound method on every
+        # attribute access — passing it twice hands the transport two
+        # different objects, so the unsubscribe in stop() silently never
+        # matched and a "disabled" replicator kept applying inbound frames.
+        self._on_message_cb = self._on_message
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._server.enable_events(True)
-        self._transport.subscribe(self._topic, self._on_message)
+        self._transport.subscribe(self._topic, self._on_message_cb)
         self._drain_thread = threading.Thread(
             target=self._drain_loop, daemon=True, name="mkv-replicator-drain"
         )
@@ -180,7 +197,7 @@ class Replicator:
         # no write acked during this teardown bypasses the journal (the
         # store's own drain resumes the queue right after).
         self.flush()
-        self._transport.unsubscribe(self._on_message)
+        self._transport.unsubscribe(self._on_message_cb)
 
     # -- outbound -----------------------------------------------------------
     def flush(self) -> int:
@@ -255,9 +272,23 @@ class Replicator:
             get_metrics().inc("replicator.coalesced", dropped)
         published = 0
         metrics = get_metrics()
+        # A traced flush (rare: read-your-writes flush inside a traced
+        # cycle, tests) stamps the envelope so the apply side stitches.
+        trace = tracewire.current_token()
         for frame in self._split_frames(kept):
             metrics.observe_size("replicator.batch_size", len(frame))
-            if self._publish(encode_batch_cbor(frame, self.node_id)):
+            # HWM counts events handed to the transport INCLUDING this
+            # frame, publish-success or not: a dropped frame must read as
+            # peer lag until anti-entropy repairs it (obs/lag.py).
+            self._pub_seq += len(frame)
+            payload = encode_batch_cbor(
+                frame,
+                self.node_id,
+                hwm_seq=self._pub_seq,
+                hwm_ts=time.time_ns(),
+                trace=trace,
+            )
+            if self._publish(payload):
                 published += len(frame)
         return published
 
@@ -318,9 +349,9 @@ class Replicator:
             frames, self._held = self._held, []
             self._holding = False
             replayed = 0
-            for events in frames:
+            for events, meta in frames:
                 # Journaled at buffer time — replay must not re-journal.
-                self._apply_frame(events, journal=False)
+                self._apply_frame(events, journal=False, meta=meta)
                 replayed += len(events)
             if replayed:
                 # Events, like replicator.buffered: after every release
@@ -332,7 +363,7 @@ class Replicator:
     # -- inbound ------------------------------------------------------------
     def _on_message(self, topic: str, payload: bytes) -> None:
         try:
-            events = decode_events(payload)
+            events, meta = decode_events_meta(payload)
         except ValueError:
             # Malformed messages (and unknown envelope versions) are
             # tolerated, like the reference's decoder fallthrough
@@ -345,6 +376,16 @@ class Replicator:
             return
         self.received += len(events)
         get_metrics().inc("replicator.received", len(events))
+        if self._lag is not None:
+            # Record the publish HWM at DECODE time: a frame held by a
+            # bootstrap (or stuck behind a slow apply) reads as lag until
+            # its apply accounts for it.
+            self._lag.on_frame(
+                meta.get("src", ""),
+                len(events),
+                hseq=meta.get("hseq", 0),
+                hts_ns=meta.get("hts", 0),
+            )
         with self._applier_mu:
             if self._holding:
                 # Journal NOW (recovery replay is LWW-conditional, so
@@ -363,41 +404,74 @@ class Replicator:
                         ]
                     )
                 if len(self._held) < self._MAX_HELD_FRAMES:
-                    self._held.append(events)
+                    self._held.append((events, meta))
                     self.buffered += len(events)
                     get_metrics().inc("replicator.buffered", len(events))
                 else:
                     # Journaled but not replayable in RAM: anti-entropy
-                    # repairs the residue (frame-loss semantics, counted).
+                    # repairs the residue (frame-loss semantics, counted;
+                    # the lag plane keeps showing it until a converged
+                    # anti-entropy cycle clears the residue).
                     get_metrics().inc("replicator.buffer_dropped",
                                       len(events))
                 return
-            self._apply_frame(events, journal=True)
+            self._apply_frame(events, journal=True, meta=meta)
 
-    def _apply_frame(self, events: list[ChangeEvent], journal: bool) -> None:
+    def _apply_frame(
+        self,
+        events: list[ChangeEvent],
+        journal: bool,
+        meta: Optional[dict] = None,
+    ) -> None:
         """Apply one inbound frame (callers hold ``_applier_mu``): ONE
         native batch crossing, then batch fan-out of the applied residue —
         ONE mirror staging call and (when ``journal``) ONE grouped WAL
         append per frame, the exact LWW ts riding with each op."""
+        t0_ns = time.time_ns()
         applied = self._applier.apply_batch(events)
-        if not applied:
-            return
-        pairs = [
-            (
-                ev.key.encode("utf-8", "surrogateescape"),
-                None if ev.op is OpKind.DEL else ev.val,
+        if applied:
+            pairs = [
+                (
+                    ev.key.encode("utf-8", "surrogateescape"),
+                    None if ev.op is OpKind.DEL else ev.val,
+                )
+                for ev in applied
+            ]
+            if self._mirror is not None:
+                self._mirror.apply_batch(pairs)
+            if journal and self._storage is not None:
+                self._storage.record_applied(
+                    [
+                        (key, val, ev.ts)
+                        for (key, val), ev in zip(pairs, applied)
+                    ]
+                )
+        meta = meta or {}
+        if self._lag is not None:
+            # Account the frame's FULL decoded event count (the publisher
+            # counted them all in the HWM), applied or LWW-rejected alike.
+            self._lag.on_applied(
+                meta.get("src", ""),
+                len(events),
+                hts_ns=meta.get("hts", 0),
+                oldest_event_ts_ns=min((ev.ts for ev in events), default=0),
             )
-            for ev in applied
-        ]
-        if self._mirror is not None:
-            self._mirror.apply_batch(pairs)
-        if journal and self._storage is not None:
-            self._storage.record_applied(
-                [
-                    (key, val, ev.ts)
-                    for (key, val), ev in zip(pairs, applied)
-                ]
-            )
+        tc = meta.get("tc")
+        if tc:
+            # Traced envelope: this apply stitches into the originating
+            # write's trace as an applier-role span.
+            ctx = tracewire.parse_token(tc)
+            if ctx is not None:
+                tracewire.get_collector().record(
+                    trace_id=ctx.trace_id,
+                    span_id=tracewire._new_id(),
+                    parent_id=ctx.span_id,
+                    name="replicate.apply",
+                    role="applier",
+                    ts_ns=t0_ns,
+                    dur_ns=time.time_ns() - t0_ns,
+                    node=self.node_id,
+                )
 
     # -- introspection -------------------------------------------------------
     @property

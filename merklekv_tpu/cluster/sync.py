@@ -42,6 +42,7 @@ from merklekv_tpu.client import MerkleKVClient, MerkleKVError, ProtocolError
 from merklekv_tpu.cluster.retry import SYNC_PEER, Deadline, RetryPolicy
 from merklekv_tpu.merkle.encoding import leaf_hash
 from merklekv_tpu.native_bindings import NativeEngine
+from merklekv_tpu.obs import tracewire
 from merklekv_tpu.obs.trace import (
     CycleTrace,
     PeerTrace,
@@ -210,6 +211,7 @@ class SyncManager:
         hash_page: int = 512,
         mode: str = "auto",
         bisect_threshold: int = 8192,
+        on_cycle_converged: Optional[Callable[[], None]] = None,
     ) -> None:
         self._engine = engine
         self._device = device
@@ -238,6 +240,15 @@ class SyncManager:
         # Mid-sync failure hook: the peer is reported degraded (health.py
         # flips its table entry) while its checkpointed session waits.
         self._on_peer_degraded = on_peer_degraded
+        # Convergence hook for the lag plane (obs/lag.py): fired by the
+        # periodic loop after a FULL CLEAN PASS — every configured peer
+        # synced this round with no exception, checkpoint, degradation,
+        # or down-peer skip. Only full coverage may clear dropped-frame
+        # lag residue: a single pairwise cycle against peer A proves
+        # nothing about events a partitioned peer B published (A may be
+        # missing them too), so firing per cycle would mask exactly the
+        # divergence the SLO exists to surface.
+        self._on_cycle_converged = on_cycle_converged
         self._sessions: dict[str, SyncSession] = {}
         # First-checkpoint time per peer, surviving resume/re-checkpoint
         # churn: a re-checkpoint builds a fresh SyncSession, and without
@@ -251,6 +262,43 @@ class SyncManager:
         self._stop = threading.Event()
         self.last_report: Optional[SyncReport] = None
         self.last_multi_report: Optional[MultiSyncReport] = None
+
+    # -- causal tracing -------------------------------------------------------
+    @staticmethod
+    def _cycle_trace_scope():
+        """A fresh trace root for one anti-entropy cycle, or a no-op scope
+        when propagation is disabled ([observability] trace_propagation)."""
+        import contextlib
+
+        if not tracewire.propagation_enabled():
+            return contextlib.nullcontext()
+        return tracewire.trace_scope(tracewire.new_context())
+
+    @staticmethod
+    def _attach_trace(client: MerkleKVClient) -> MerkleKVClient:
+        """Give the client the live token provider: every cluster verb it
+        sends carries the active trace context (capability fallback drops
+        it against pre-tracing peers)."""
+        client.trace_provider = tracewire.current_token
+        return client
+
+    @staticmethod
+    def _settle_trace_capability(client: MerkleKVClient) -> None:
+        """Prove (or disprove) the peer's trace capability with a
+        fail-closed zero-width TREELEVEL probe before any verb whose
+        trailing token an old peer would misread as a real argument
+        (LEAFHASHES prefix, HASHPAGE cursor) — see client._traced_request
+        require_settled. No-op when untraced or already settled."""
+        if (
+            not tracewire.propagation_enabled()
+            or tracewire.current() is None
+            or client._peer_traced is not None
+        ):
+            return
+        try:
+            client.tree_level(0, 0, 0)
+        except Exception:
+            pass  # capability state is settled either way
 
     # -- failure bookkeeping --------------------------------------------------
     def _degrade(self, peer: str, reason: str) -> None:
@@ -323,7 +371,10 @@ class SyncManager:
         started, t0 = time.time(), time.perf_counter()
         cid = next_cycle_id()
         try:
-            with cycle_scope(cid), \
+            # Causal trace root for the whole cycle: spans inside stitch
+            # under it, and the clients' trace tokens carry it to the peer
+            # so the donor's serve spans land under the SAME trace id.
+            with self._cycle_trace_scope(), cycle_scope(cid), \
                     span("anti_entropy.sync_once", peer=peer) as rec:
                 report = self._sync_once(host, port, full, verify,
                                          trace=trace)
@@ -359,7 +410,9 @@ class SyncManager:
         deadline = self._retry.deadline()
         self._degraded_this_cycle.discard(peer)
 
-        client = MerkleKVClient(host, port, timeout=self._timeout)
+        client = self._attach_trace(
+            MerkleKVClient(host, port, timeout=self._timeout)
+        )
         try:
             self._retry.run(
                 client.connect,
@@ -1009,6 +1062,10 @@ class SyncManager:
         all-or-nothing transfer. Returns False when the peer does not serve
         HASHPAGE (caller degrades to the monolithic paths)."""
         peer = report.peer
+        # Pure-page cycles never send a fixed-arity traced verb, so the
+        # donor's HASHPAGE spans would stay untraced (require_settled)
+        # without this one probe per cycle.
+        self._settle_trace_capability(client)
         # The local snapshot + hash pass is deferred until the first page
         # proves the peer serves HASHPAGE: against an old peer this path
         # bails to the monolithic fallback, which computes its own
@@ -1391,7 +1448,7 @@ class SyncManager:
         started, t0 = time.time(), time.perf_counter()
         cid = next_cycle_id()
         try:
-            with cycle_scope(cid), \
+            with self._cycle_trace_scope(), cycle_scope(cid), \
                     span("anti_entropy.sync_multi",
                          peers=",".join(peers)) as rec:
                 report = self._sync_multi(peers, traces=traces)
@@ -1461,7 +1518,9 @@ class SyncManager:
             host, _, port = peer.rpartition(":")
             c: Optional[MerkleKVClient] = None
             try:
-                c = MerkleKVClient(host, int(port), timeout=self._timeout)
+                c = self._attach_trace(
+                    MerkleKVClient(host, int(port), timeout=self._timeout)
+                )
                 c.connect()
             except Exception as e:
                 drop_peer(c, peer, f"{peer}: unreachable ({e!r})")
@@ -1508,6 +1567,7 @@ class SyncManager:
                     )
                     report.degraded.append(peer)
                     continue
+            self._settle_trace_capability(c)
             try:
                 decoded = _decode_leaf_map(c.leaf_hashes_ts())
             except Exception:
@@ -1808,16 +1868,24 @@ class SyncManager:
                 skipped = len(peers) - len(live_peers)
                 if skipped:
                     get_metrics().inc("anti_entropy.down_peer_skips", skipped)
+                # Full clean pass: EVERY configured peer synced this round
+                # with nothing checkpointed/degraded/skipped. Only that
+                # proves enough coverage to clear dropped-frame lag
+                # residue (see __init__ on the hook).
+                full_pass = skipped == 0 and bool(live_peers)
                 if multi_peer:
                     if not live_peers:
                         continue
                     try:
-                        self.sync_multi(live_peers)
+                        rep = self.sync_multi(live_peers)
+                        full_pass = full_pass and not rep.degraded
                     except Exception:
                         # Retried next round — but never silently: a loop
                         # that throws every cycle looks like a healthy
                         # no-op without this counter.
                         get_metrics().inc("anti_entropy.loop_errors")
+                        full_pass = False
+                    self._fire_converged(full_pass)
                     continue
                 for peer in live_peers:
                     if self._stop.is_set():
@@ -1835,13 +1903,27 @@ class SyncManager:
                         get_metrics().inc("anti_entropy.loop_errors")
                         if peer not in self._degraded_this_cycle:
                             self._degrade(peer, f"sync cycle failed: {e!r}")
+                        full_pass = False
                         continue
+                    if (
+                        peer in self._sessions
+                        or peer in self._degraded_this_cycle
+                    ):
+                        full_pass = False
+                self._fire_converged(full_pass)
 
         self._stop.clear()
         self._loop_thread = threading.Thread(
             target=run, daemon=True, name="mkv-anti-entropy"
         )
         self._loop_thread.start()
+
+    def _fire_converged(self, full_pass: bool) -> None:
+        if full_pass and self._on_cycle_converged is not None:
+            try:
+                self._on_cycle_converged()
+            except Exception:
+                pass  # a broken lag hook must never stall the loop
 
     def stop(self) -> None:
         self._stop.set()

@@ -245,6 +245,23 @@ class ObservabilityConfig:
     http_host: str = "127.0.0.1"
     # Ring-buffer capacity of the TRACE verb's cycle store.
     trace_cycles: int = 128
+    # Causal trace propagation (obs/tracewire.py): anti-entropy cycles
+    # allocate a trace context and cluster verbs carry the tc= token so
+    # donor spans stitch into the initiator's trace. Off reverts to the
+    # process-local TRACE surface only.
+    trace_propagation: bool = True
+    # Span-collector ring capacity (spans, not cycles) behind TRACEDUMP.
+    trace_spans: int = 8192
+    # Convergence-lag SLO plane (obs/lag.py): /healthz readiness flips to
+    # "lagging" when a frame applies more than lag_ms_threshold behind its
+    # publish clock (or any lag residue exists), and to "diverged" when
+    # residue persists past diverged_after_s without an anti-entropy
+    # convergence clearing it.
+    lag_ms_threshold: float = 1000.0
+    diverged_after_s: float = 120.0
+    # PROFILE verb capture directory ("" = <storage_path>/profiles or a
+    # temp dir on storage-less nodes).
+    profile_dir: str = ""
 
 
 @dataclass
@@ -342,6 +359,32 @@ class Config:
             cfg.observability.http_host = str(obs["http_host"])
         if "trace_cycles" in obs:
             cfg.observability.trace_cycles = int(obs["trace_cycles"])
+        if "trace_propagation" in obs:
+            cfg.observability.trace_propagation = bool(
+                obs["trace_propagation"]
+            )
+        if "trace_spans" in obs:
+            cfg.observability.trace_spans = int(obs["trace_spans"])
+        if "lag_ms_threshold" in obs:
+            cfg.observability.lag_ms_threshold = float(
+                obs["lag_ms_threshold"]
+            )
+        if "diverged_after_s" in obs:
+            cfg.observability.diverged_after_s = float(
+                obs["diverged_after_s"]
+            )
+        if "profile_dir" in obs:
+            cfg.observability.profile_dir = str(obs["profile_dir"])
+        if cfg.observability.lag_ms_threshold <= 0:
+            raise ValueError(
+                "[observability] lag_ms_threshold must be > 0, got "
+                f"{cfg.observability.lag_ms_threshold}"
+            )
+        if cfg.observability.diverged_after_s <= 0:
+            raise ValueError(
+                "[observability] diverged_after_s must be > 0, got "
+                f"{cfg.observability.diverged_after_s}"
+            )
         if cfg.observability.http_port < -1:
             raise ValueError(
                 "[observability] http_port must be -1 (ephemeral), 0 "

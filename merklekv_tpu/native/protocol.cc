@@ -148,7 +148,30 @@ ParseResult parse_numeric(Verb verb, const std::string& name,
   return ok(std::move(c));
 }
 
+// If the last whitespace token of `toks` is a trace-context token, pop it
+// and return it; otherwise return "". Callers run this BEFORE arity checks
+// so a traced request parses exactly like its untraced form.
+std::string take_trace_token(std::vector<std::string>* toks) {
+  if (toks->empty() || !is_trace_token(toks->back())) return "";
+  std::string t = std::move(toks->back());
+  toks->pop_back();
+  return t;
+}
+
 }  // namespace
+
+bool is_trace_token(const std::string& tok) {
+  // "tc=" + 16 hex + "-" + 16 hex + "-" + 2 hex  (= 3 + 16 + 1 + 16 + 1 + 2)
+  if (tok.size() != 39 || tok.compare(0, 3, "tc=") != 0) return false;
+  auto hex = [&](size_t b, size_t n) {
+    for (size_t i = b; i < b + n; ++i) {
+      if (!std::isxdigit(uint8_t(tok[i]))) return false;
+    }
+    return true;
+  };
+  return hex(3, 16) && tok[19] == '-' && hex(20, 16) && tok[36] == '-' &&
+         hex(37, 2);
+}
 
 ParseResult parse_command(const std::string& line) {
   std::string input = trim(line);
@@ -182,6 +205,14 @@ ParseResult parse_command(const std::string& line) {
     if (u == "PEERS") { c.verb = Verb::Peers; return ok(std::move(c)); }
     if (u == "SNAPMETA") { c.verb = Verb::SnapMeta; return ok(std::move(c)); }
     if (u == "METRICS") { c.verb = Verb::Metrics; return ok(std::move(c)); }
+    if (u == "TRACEDUMP") {
+      c.verb = Verb::TraceDump;
+      c.amount = 0;  // bare TRACEDUMP: every span still in the collector
+      return ok(std::move(c));
+    }
+    if (u == "PROFILE") {
+      return err("PROFILE requires a positive duration in seconds");
+    }
     if (u == "TRACE") {
       c.verb = Verb::Trace;
       c.amount = 8;  // bare TRACE: a useful default window
@@ -342,22 +373,31 @@ ParseResult parse_command(const std::string& line) {
     // Anti-entropy wire verb: per-key leaf digests so peers can diff
     // without shipping values (the hash-walk the reference documents,
     // README.md:310-372, but never implemented — sync.rs:150-214 ships
-    // full state).
-    if (rest.find(' ') != std::string::npos) {
+    // full state). Traced like the other cluster verbs: the multi-peer
+    // gather is the one fused fetch a cycle makes per peer, so its serve
+    // span is what stitches that peer into the cycle's trace.
+    auto toks = split_ws(rest);
+    std::string trace = take_trace_token(&toks);
+    if (toks.size() > 1) {
       return err("LEAFHASHES command accepts only one argument");
     }
-    if (auto e = bad_char(rest, "prefix")) return err(*e);
+    if (!toks.empty()) {
+      if (auto e = bad_char(toks[0], "prefix")) return err(*e);
+    }
     Command c;
     c.verb = Verb::LeafHashes;
-    c.prefix = rest;
+    c.trace = std::move(trace);
+    c.prefix = toks.empty() ? "" : toks[0];
     return ok(std::move(c));
   }
   if (u == "HASHPAGE") {
     // "HASHPAGE <count> [<after> [<upto>]]" — the paged form of LEAFHASHES.
     // The cursor is a key (exclusive lower bound) and <upto> an exclusive
     // upper bound; keys cannot contain spaces, so plain whitespace
-    // splitting is unambiguous.
+    // splitting is unambiguous. A trailing trace-context token is stripped
+    // first (its fixed tc= shape cannot collide with a real cursor key).
     auto toks = split_ws(rest);
+    std::string trace = take_trace_token(&toks);
     if (toks.empty() || toks.size() > 3) {
       return err("HASHPAGE requires arguments: <count> [<after> [<upto>]]");
     }
@@ -367,6 +407,7 @@ ParseResult parse_command(const std::string& line) {
     }
     Command c;
     c.verb = Verb::HashPage;
+    c.trace = std::move(trace);
     c.amount = count;
     if (toks.size() >= 2) {
       if (auto e = bad_char(toks[1], "key")) return err(*e);
@@ -384,8 +425,10 @@ ParseResult parse_command(const std::string& line) {
   if (u == "TREELEVEL") {
     // "TREELEVEL <level> <lo> <hi>" — interior digests [lo, hi) of the
     // reference tree at `level` (0 = leaves). lo == hi is a valid empty
-    // probe (capability check + leaf-count fetch).
+    // probe (capability check + leaf-count fetch). An optional trailing
+    // trace-context token stitches the serve into the walker's trace.
     auto toks = split_ws(rest);
+    std::string trace = take_trace_token(&toks);
     if (toks.size() != 3) {
       return err("TREELEVEL requires arguments: <level> <lo> <hi>");
     }
@@ -399,17 +442,21 @@ ParseResult parse_command(const std::string& line) {
     }
     Command c;
     c.verb = Verb::TreeLevel;
+    c.trace = std::move(trace);
     c.level = level;
     c.lo = lo;
     c.hi = hi;
     return ok(std::move(c));
   }
   if (u == "SNAPMETA") {
-    if (!rest.empty()) {
+    auto toks = split_ws(rest);
+    std::string trace = take_trace_token(&toks);
+    if (!toks.empty()) {
       return err("SNAPMETA command does not accept any arguments");
     }
     Command c;
     c.verb = Verb::SnapMeta;
+    c.trace = std::move(trace);
     return ok(std::move(c));
   }
   if (u == "SNAPCHUNK") {
@@ -418,6 +465,7 @@ ParseResult parse_command(const std::string& line) {
     // donor-side compaction between chunks can never switch artifacts
     // under a transfer.
     auto toks = split_ws(rest);
+    std::string trace = take_trace_token(&toks);
     if (toks.size() != 3) {
       return err("SNAPCHUNK requires arguments: <seq> <offset> <count>");
     }
@@ -433,6 +481,7 @@ ParseResult parse_command(const std::string& line) {
     }
     Command c;
     c.verb = Verb::SnapChunk;
+    c.trace = std::move(trace);
     c.snap_seq = seq;
     c.snap_off = off;
     c.snap_cnt = cnt;
@@ -448,6 +497,31 @@ ParseResult parse_command(const std::string& line) {
     Command c;
     c.verb = Verb::Trace;
     c.amount = n;
+    return ok(std::move(c));
+  }
+  if (u == "TRACEDUMP") {
+    // "TRACEDUMP [n]" — up to n newest causal-trace spans (0/absent = all).
+    auto toks = split_ws(rest);
+    int64_t n = 0;
+    if (toks.size() != 1 || !parse_i64_str(toks[0], &n) || n < 0) {
+      return err("TRACEDUMP accepts one non-negative integer count");
+    }
+    Command c;
+    c.verb = Verb::TraceDump;
+    c.amount = n;
+    return ok(std::move(c));
+  }
+  if (u == "PROFILE") {
+    // "PROFILE <secs>" — bounded device profiler capture.
+    auto toks = split_ws(rest);
+    int64_t secs = 0;
+    if (toks.size() != 1 || !parse_i64_str(toks[0], &secs) || secs <= 0 ||
+        secs > 600) {
+      return err("PROFILE requires a duration in seconds (1..600)");
+    }
+    Command c;
+    c.verb = Verb::Profile;
+    c.amount = secs;
     return ok(std::move(c));
   }
   if (u == "INC") return parse_numeric(Verb::Increment, "INC", rest);

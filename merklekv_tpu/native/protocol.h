@@ -50,6 +50,16 @@ enum class Verb {
   // anti-entropy walk.
   SnapMeta,
   SnapChunk,
+  // Extension: "TRACEDUMP [n]" dumps raw causal-trace spans (the cross-node
+  // complement of TRACE's per-cycle summaries) from the control plane's
+  // span collector; obs/tracewire.py assembles initiator+donor dumps into
+  // one Chrome trace-event JSON. Without a cluster plane: "SPANS 0" + END.
+  TraceDump,
+  // Extension: "PROFILE <secs>" starts a bounded jax.profiler device-trace
+  // capture in the control plane (rebuild/diff/scatter programs land in the
+  // capture); answers the capture directory immediately, the capture stops
+  // itself after <secs>. Without a cluster plane (or without jax): ERROR.
+  Profile,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };
@@ -67,6 +77,13 @@ struct Command {
   int64_t level = 0, lo = 0, hi = 0;   // TreeLevel
   int64_t snap_seq = 0, snap_off = 0, snap_cnt = 0;  // SnapChunk
   std::optional<std::string> pattern;  // Hash
+  // Causal trace context: the optional trailing "tc=<trace>-<span>-<flags>"
+  // token on cluster verbs (TREELEVEL/HASHPAGE/SNAPMETA/SNAPCHUNK). The
+  // server relays it (with the serving wall time) to the control plane as a
+  // TRACESPAN notification so the donor's spans stitch into the
+  // initiator's trace; empty = untraced request. Strictly-formatted so a
+  // real key can never be mistaken for it (see is_trace_token).
+  std::string trace;
   std::string host;                // Sync
   uint16_t port = 0;               // Sync
   bool full = false, verify = false;  // Sync flags (parsed, ignored — parity)
@@ -82,5 +99,11 @@ struct ParseResult {
 // `line` is the raw request line (trailing \r\n included or not — it is
 // trimmed here, like the reference's input.trim()).
 ParseResult parse_command(const std::string& line);
+
+// True iff `tok` is a well-formed trace-context token:
+// "tc=" + 16 hex (trace id) + "-" + 16 hex (span id) + "-" + 2 hex (flags).
+// The fixed shape is what lets it ride as a trailing argument on verbs
+// whose other arguments are keys without ambiguity.
+bool is_trace_token(const std::string& tok);
 
 }  // namespace mkv

@@ -23,6 +23,24 @@ namespace {
 
 uint64_t unix_now() { return uint64_t(::time(nullptr)); }
 
+uint64_t unix_now_ns() {
+  return uint64_t(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                      std::chrono::system_clock::now().time_since_epoch())
+                      .count());
+}
+
+// Wire verb name for the TRACESPAN notification (traced cluster verbs only).
+const char* traced_verb_name(Verb v) {
+  switch (v) {
+    case Verb::TreeLevel: return "TREELEVEL";
+    case Verb::HashPage: return "HASHPAGE";
+    case Verb::LeafHashes: return "LEAFHASHES";
+    case Verb::SnapMeta: return "SNAPMETA";
+    case Verb::SnapChunk: return "SNAPCHUNK";
+    default: return "CMD";
+  }
+}
+
 bool send_all(int fd, const std::string& data) {
   size_t off = 0;
   while (off < data.size()) {
@@ -215,14 +233,37 @@ bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
       // enough to stay on by default on the SET hot path (bench.py
       // measures the overhead; set_latency_enabled is the A/B switch).
       const bool timed = latency_enabled_.load(std::memory_order_acquire);
-      const auto t0 = timed ? std::chrono::steady_clock::now()
-                            : std::chrono::steady_clock::time_point{};
+      const bool traced = !parsed.cmd.trace.empty();
+      const auto t0 = (timed || traced)
+                          ? std::chrono::steady_clock::now()
+                          : std::chrono::steady_clock::time_point{};
+      // Wall-clock start rides with the TRACESPAN notification so the
+      // collector can place the donor span on the initiator's timeline
+      // (cross-node skew is the usual Dapper caveat, documented).
+      const uint64_t wall0 = traced ? unix_now_ns() : 0;
       std::string response = dispatch(parsed.cmd, &close_conn);
-      if (timed) {
-        stats_.latency.observe_ns(uint64_t(
+      if (timed || traced) {
+        const uint64_t dur_ns = uint64_t(
             std::chrono::duration_cast<std::chrono::nanoseconds>(
                 std::chrono::steady_clock::now() - t0)
-                .count()));
+                .count());
+        if (timed) stats_.latency.observe_ns(dur_ns);
+        if (traced) {
+          // Fire-and-forget span notification to the control plane: only
+          // traced cluster verbs pay this (a handful per sync cycle, never
+          // the GET/SET hot path); the response is ignored — a node
+          // without a cluster plane simply drops the span.
+          ClusterCallback cb;
+          {
+            std::lock_guard lk(cb_mu_);
+            cb = cluster_cb_;
+          }
+          if (cb) {
+            cb(std::string("TRACESPAN ") + traced_verb_name(parsed.cmd.verb) +
+               " " + parsed.cmd.trace + " " + std::to_string(wall0) + " " +
+               std::to_string(dur_ns));
+          }
+        }
       }
       if (!send_all(fd, response)) return false;
       if (close_conn) return true;
@@ -370,6 +411,37 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         if (!resp.empty()) return resp;
       }
       return "TRACES 0\r\nEND\r\n";
+    }
+    case Verb::TraceDump: {
+      // Raw causal-trace spans from the control plane's collector (the
+      // cross-node stitching input; obs/tracewire.py assembles dumps from
+      // several nodes into one Chrome trace-event JSON).
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp =
+            cb("TRACEDUMP " + std::to_string(cmd.amount.value_or(0)));
+        if (!resp.empty()) return resp;
+      }
+      return "SPANS 0\r\nEND\r\n";
+    }
+    case Verb::Profile: {
+      // Bounded device-profiler capture; only the control plane owns a jax
+      // runtime, so a bare native node reports unavailability.
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp =
+            cb("PROFILE " + std::to_string(cmd.amount.value_or(1)));
+        if (!resp.empty()) return resp;
+      }
+      return "ERROR device profiler unavailable\r\n";
     }
     case Verb::SnapMeta:
     case Verb::SnapChunk: {

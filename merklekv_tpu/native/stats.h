@@ -116,6 +116,8 @@ struct ServerStats {
       case Verb::Peers: management_commands++; break;
       case Verb::Metrics: management_commands++; break;
       case Verb::Trace: management_commands++; break;
+      case Verb::TraceDump: management_commands++; break;
+      case Verb::Profile: management_commands++; break;
       case Verb::Sync:
       case Verb::SnapMeta:
       case Verb::SnapChunk: sync_commands++; break;

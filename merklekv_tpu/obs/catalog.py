@@ -1,0 +1,156 @@
+"""Metric-family catalog: the single source of ``# HELP`` / ``# TYPE``.
+
+The Prometheus exporter renders every family's metadata from here, so the
+scrape page, this module, and the docs/OBSERVABILITY.md catalog cannot
+drift apart silently — ``tests/test_obs.py`` asserts (a) every cataloged
+name appears in OBSERVABILITY.md and (b) a live scrape carries HELP+TYPE
+for every family it exposes.
+
+Keys are REGISTRY names (dots, no ``mkv_`` prefix, no ``_total``/
+``_seconds`` suffix — the exporter sanitizes). Families not listed fall
+back to a generated one-liner pointing at the docs, so an uncataloged
+counter still scrapes with metadata; curating it here is the follow-up,
+not a prerequisite for adding a counter.
+
+Gauges are intentionally absent: their help text lives at
+``register_gauge`` time (the owning subsystem knows its own semantics),
+and the exporter already emits it.
+"""
+
+from __future__ import annotations
+
+__all__ = ["CATALOG", "help_for"]
+
+# name -> (kind, help). kind is informational; the exporter's TYPE line
+# derives from how the family is rendered (counter/histogram/gauge).
+CATALOG: dict[str, tuple[str, str]] = {
+    # -- anti-entropy ------------------------------------------------------
+    "anti_entropy.syncs": (
+        "counter", "Completed pairwise anti-entropy cycles."),
+    "anti_entropy.multi_syncs": (
+        "counter", "Completed multi-peer arbitration cycles."),
+    "anti_entropy.keys_repaired": (
+        "counter", "Keys set or deleted by anti-entropy repair."),
+    "anti_entropy.peer_degraded": (
+        "counter", "Sync streams that died mid-cycle (peer degraded)."),
+    "anti_entropy.sessions_checkpointed": (
+        "counter", "Interrupted repairs checkpointed for resume."),
+    "anti_entropy.sessions_resumed": (
+        "counter", "Checkpointed repair sessions resumed."),
+    "anti_entropy.sessions_abandoned": (
+        "counter", "Stalled repair sessions abandoned (fresh diff next)."),
+    "anti_entropy.interrupted_repairs": (
+        "counter", "Repair streams interrupted by faults or deadlines."),
+    "anti_entropy.loop_errors": (
+        "counter", "Periodic-loop cycles that raised (retried next round)."),
+    "anti_entropy.down_peer_skips": (
+        "counter", "Cycles that skipped a confirmed-down peer."),
+    "anti_entropy.cycle_reconnects": (
+        "counter", "In-cycle reconnects after a dead stream."),
+    "anti_entropy.probe_failures": (
+        "counter", "HASH root probes that failed against a live peer."),
+    "anti_entropy.verify_failures": (
+        "counter", "Post-repair root verifications that mismatched."),
+    "anti_entropy.leafhash_fallbacks": (
+        "counter", "Cycles degraded to full transfer (no LEAFHASHES)."),
+    "anti_entropy.leafhash_aborts": (
+        "counter", "LEAFHASHES fetches aborted by transport death."),
+    "sync.bytes_sent": (
+        "counter", "Anti-entropy wire bytes sent (client-measured)."),
+    "sync.bytes_received": (
+        "counter", "Anti-entropy wire bytes received (client-measured)."),
+    "sync.nodes_compared": (
+        "counter", "Merkle tree nodes compared during bisection walks."),
+    "sync.rounds": (
+        "counter", "Bisection-walk level rounds (TREELEVEL batches)."),
+    # -- replication -------------------------------------------------------
+    "replicator.published": (
+        "counter", "Replication events published to the fabric."),
+    "replicator.received": (
+        "counter", "Replication events received from the fabric."),
+    "replicator.coalesced": (
+        "counter", "Events folded away by per-key frame coalescing."),
+    "replicator.publish_errors": (
+        "counter", "Frames dropped after publish retries (QoS-0)."),
+    "replicator.decode_errors": (
+        "counter", "Undecodable or unknown-version inbound frames."),
+    "replicator.buffered": (
+        "counter", "Events journaled-and-held while a bootstrap runs."),
+    "replicator.buffer_replayed": (
+        "counter", "Held events replayed at bootstrap gate-open."),
+    "replicator.buffer_dropped": (
+        "counter", "Held events dropped past the RAM cap (repaired later)."),
+    "replicator.batch_size": (
+        "histogram", "Events per published replication frame (size "
+        "histogram: le bounds are event counts)."),
+    "replication.convergence": (
+        "histogram", "Write origin to applied-on-this-replica delay "
+        "(seconds); max() across instances = write-to-all-replicas."),
+    # -- health / transport ------------------------------------------------
+    "health.peer_failures": (
+        "counter", "Peers confirmed down by consecutive probe failures."),
+    "health.peer_recoveries": (
+        "counter", "Down peers that answered a probe again."),
+    "health.peer_degradations": (
+        "counter", "Mid-operation failures reported against peers."),
+    "health.probe_errors": (
+        "counter", "Probe rounds that raised internally."),
+    # -- storage -----------------------------------------------------------
+    "storage.wal_appends": ("counter", "WAL frames appended."),
+    "storage.wal_fsyncs": ("counter", "WAL fsync calls."),
+    "storage.snapshots": ("counter", "Snapshots written."),
+    "storage.recovery_replayed": (
+        "counter", "WAL records replayed during recovery."),
+    "storage.recovery_root_mismatch": (
+        "counter", "Snapshots rejected by root verification."),
+    "storage.wal_fsync": ("histogram", "WAL fsync latency."),
+    # -- device plane ------------------------------------------------------
+    "device.scatter_keys": (
+        "counter", "Keys updated via incremental device scatter."),
+    "device.scatter_bytes": (
+        "counter", "Bytes transferred by device scatter batches."),
+    "device.restructure_keys": (
+        "counter", "Keys in structural (insert/delete) device batches."),
+    "device.restructure_bytes": (
+        "counter", "Bytes transferred by structural device batches."),
+    "device.scatter_dispatch": (
+        "histogram", "Scatter-batch dispatch (async enqueue) latency."),
+    "device.restructure_dispatch": (
+        "histogram", "Structural-batch dispatch (async enqueue) latency."),
+    "profiler.captures": (
+        "counter", "PROFILE verb device-profiler captures started."),
+    # -- bootstrap ---------------------------------------------------------
+    "bootstrap.bytes_fetched": (
+        "counter", "Raw snapshot bytes fetched by the joiner."),
+    "bootstrap.chunks": ("counter", "SNAPCHUNK frames fetched."),
+    "bootstrap.chunk_retries": (
+        "counter", "Chunk offsets retried after integrity/transport "
+        "failures."),
+    "bootstrap.donor_failovers": (
+        "counter", "Donors abandoned mid-transfer for the next candidate."),
+    "bootstrap.verify_failures": (
+        "counter", "Assembled snapshots that failed stamp verification."),
+    "bootstrap.capability_misses": (
+        "counter", "Donors that cannot serve snapshots (old/storage-less)."),
+    "bootstrap.fallbacks": (
+        "counter", "Bootstraps degraded to the plain anti-entropy walk."),
+    "bootstrap.completed": ("counter", "Bootstrap runs that reached LIVE."),
+    "bootstrap.donor_chunks": (
+        "counter", "SNAPCHUNK frames served as a donor."),
+    "bootstrap.donor_bytes": (
+        "counter", "Raw snapshot bytes served as a donor."),
+    # -- exporter-built families ------------------------------------------
+    "span_duration": (
+        "histogram", "Control-plane span latency (per span name)."),
+    "native_cmd_latency": (
+        "histogram", "Native server per-command dispatch latency."),
+}
+
+
+def help_for(name: str, kind: str) -> str:
+    """Catalog help for a registry family, or a generated fallback so no
+    family ever scrapes without metadata."""
+    entry = CATALOG.get(name)
+    if entry is not None:
+        return entry[1]
+    return f"Uncataloged {kind} {name} (see docs/OBSERVABILITY.md)."

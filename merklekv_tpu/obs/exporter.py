@@ -27,6 +27,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from merklekv_tpu.obs.catalog import help_for
 from merklekv_tpu.obs.metrics import (
     BUCKET_BOUNDS,
     SIZE_SCALE,
@@ -91,8 +92,8 @@ def _native_histogram(stats: dict[str, str]) -> Optional[list[str]]:
         return None
     buckets.sort(key=lambda b: b[0])
     out = [
-        "# HELP mkv_native_cmd_latency_seconds Native server per-command "
-        "dispatch latency.",
+        "# HELP mkv_native_cmd_latency_seconds "
+        + help_for("native_cmd_latency", "histogram"),
         "# TYPE mkv_native_cmd_latency_seconds histogram",
     ]
     cum, cumulative = 0, []
@@ -123,6 +124,12 @@ def render_prometheus(
     snap = reg.snapshot()
     for name in sorted(snap["counters"]):
         san = _san(name)
+        # HELP + TYPE for EVERY family, text from the single catalog
+        # (obs/catalog.py) — uncataloged names get a generated fallback so
+        # no family ever scrapes bare.
+        out.append(
+            f"# HELP mkv_{san}_total {help_for(name, 'counter')}"
+        )
         out.append(f"# TYPE mkv_{san}_total counter")
         out.append(f"mkv_{san}_total {snap['counters'][name]}")
 
@@ -135,8 +142,8 @@ def render_prometheus(
     }
     if span_hists:
         out.append(
-            "# HELP mkv_span_duration_seconds Control-plane span latency "
-            "(per span name)."
+            "# HELP mkv_span_duration_seconds "
+            + help_for("span_duration", "histogram")
         )
         out.append("# TYPE mkv_span_duration_seconds histogram")
         for sname in sorted(span_hists):
@@ -162,6 +169,7 @@ def render_prometheus(
         scale = 1.0 / SIZE_SCALE if is_size else 1.0
         suffix = "" if is_size else "_seconds"
         family = f"mkv_{_san(name)}{suffix}"
+        out.append(f"# HELP {family} {help_for(name, 'histogram')}")
         out.append(f"# TYPE {family} histogram")
         cum, cumulative = 0, []
         for bound, c in zip(BUCKET_BOUNDS, h["counts"]):
@@ -174,8 +182,11 @@ def render_prometheus(
 
     for name, g in sorted(reg.gauges_snapshot().items()):
         san = _san(name)
-        if g["help"]:
-            out.append(f"# HELP mkv_{san} {g['help']}")
+        # Gauge help comes from its registration (the owning subsystem);
+        # the catalog fallback covers help-less registrations.
+        out.append(
+            f"# HELP mkv_{san} {g['help'] or help_for(name, 'gauge')}"
+        )
         out.append(f"# TYPE mkv_{san} gauge")
         value = g["value"]
         if isinstance(value, dict):
@@ -217,9 +228,17 @@ def render_prometheus(
             if name.endswith(("_commands", "_connections")) or name in (
                 "tombstone_evictions",
             ):
+                out.append(
+                    f"# HELP mkv_native_{san} "
+                    + help_for(f"native.{name}", "counter")
+                )
                 out.append(f"# TYPE mkv_native_{san} counter")
                 out.append(f"mkv_native_{san} {_fmt(num)}")
             else:
+                out.append(
+                    f"# HELP mkv_native_{san} "
+                    + help_for(f"native.{name}", "gauge")
+                )
                 out.append(f"# TYPE mkv_native_{san} gauge")
                 out.append(f"mkv_native_{san} {_fmt(num)}")
 

@@ -42,6 +42,12 @@ class NodeSample:
     latency_p50_us: Optional[float] = None
     peers_up: int = 0
     peers_total: int = 0
+    # Convergence-lag plane (METRICS replication.lag_* lines): the WORST
+    # peer's values, plus the node's readiness level (live|lagging|
+    # diverged; "-" on nodes predating the lag plane).
+    lag_events: int = 0
+    lag_ms: float = 0.0
+    readiness: str = "-"
 
 
 def _p50_from_stats(stats: dict[str, str]) -> Optional[float]:
@@ -99,6 +105,18 @@ def sample_node(node: str, timeout: float = 2.0) -> NodeSample:
     )
     s.peers_total = len(peers)
     s.peers_up = sum(1 for p in peers if p.get("status") == "up")
+    from merklekv_tpu.obs.lag import READINESS_CODES
+
+    names = {str(code): name for name, code in READINESS_CODES.items()}
+    s.readiness = names.get(metrics.get("readiness_code", ""), "-")
+    for name, value in metrics.items():
+        try:
+            if name.startswith("replication.lag_events."):
+                s.lag_events = max(s.lag_events, int(value))
+            elif name.startswith("replication.lag_ms."):
+                s.lag_ms = max(s.lag_ms, float(value))
+        except ValueError:
+            continue
     return s
 
 
@@ -111,7 +129,8 @@ def render_table(
 ) -> str:
     header = (
         f"{'NODE':<22} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} {'GET/S':>8} "
-        f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONN':>5} {'PEERS_UP':>9} STATUS"
+        f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONN':>5} {'PEERS_UP':>9} "
+        f"{'LAG_EV':>7} {'LAG_MS':>8} {'READY':>8} STATUS"
     )
     lines = [header, "-" * len(header)]
     for node in cur:
@@ -120,6 +139,7 @@ def render_table(
         if not c.ok:
             lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
                          f"{'-':>7} {'-':>10} {'-':>5} {'-':>9} "
+                         f"{'-':>7} {'-':>8} {'-':>8} "
                          f"DOWN ({c.error})")
             continue
         dt = (c.unix - p.unix) if (p is not None and p.ok) else 0.0
@@ -136,7 +156,8 @@ def render_table(
         lines.append(
             f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
             f"{p50:>7} {sync_kb:>10.1f} {c.active_connections:>5} "
-            f"{peers:>9} UP"
+            f"{peers:>9} {c.lag_events:>7} {c.lag_ms:>8.1f} "
+            f"{c.readiness:>8} UP"
         )
     return "\n".join(lines)
 

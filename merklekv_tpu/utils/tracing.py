@@ -28,6 +28,7 @@ import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
 
+from merklekv_tpu.obs import tracewire
 from merklekv_tpu.obs.metrics import Metrics, get_metrics
 from merklekv_tpu.obs.trace import current_cycle_id
 
@@ -38,8 +39,15 @@ __all__ = ["span", "Metrics", "get_metrics", "device_profile"]
 
 @contextmanager
 def span(name: str, **fields) -> Iterator[dict]:
-    """Timed span; yields a dict callers may stuff result fields into."""
+    """Timed span; yields a dict callers may stuff result fields into.
+
+    When a causal trace is active (obs/tracewire.py), the span also lands
+    in the process-wide SpanCollector: it allocates a child span id and
+    installs it for its duration, so nested spans — and traced wire
+    requests issued inside — parent to it and the donor's serve spans
+    stitch under this node's walk."""
     extra: dict = {}
+    tstate = tracewire.begin_span()
     t0 = time.perf_counter()
     error: Optional[str] = None
     try:
@@ -57,6 +65,10 @@ def span(name: str, **fields) -> Iterator[dict]:
             record["cycle"] = cycle
         if error is not None:
             record["error"] = error
+        if tstate is not None:
+            tracewire.end_span(
+                tstate, name, int(dt * 1e9), error=error, cycle=cycle or 0
+            )
         logger.info(json.dumps(record, default=str))
 
 

@@ -1,0 +1,111 @@
+"""CI bench-regression gate (tools/bench_gate.py): scenario extraction
+from driver round records (parsed headline + stderr-tail JSON lines),
+direction-aware >20% regression detection, and the skip rules for
+crashed/unusable rounds."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools"),
+)
+
+import bench_gate  # noqa: E402
+
+
+def _round(headline_value, tail_scenarios, rc=0):
+    tail = "some noise\n" + "".join(
+        json.dumps(s) + "\n" for s in tail_scenarios
+    )
+    rec = {"rc": rc, "tail": tail}
+    if headline_value is not None:
+        rec["parsed"] = {
+            "metric": "merkle_rebuild_diff_keys_per_s",
+            "value": headline_value,
+            "unit": "keys/s",
+        }
+    return rec
+
+
+def test_extract_scenarios_headline_and_tail():
+    rec = _round(1000.0, [
+        {"metric": "op_latency_us", "value": 15.0, "unit": "us (GET p50)"},
+        {"metric": "broken", "value": None},
+        {"not_a": "scenario"},
+    ])
+    out = bench_gate.extract_scenarios(rec)
+    assert set(out) == {"merkle_rebuild_diff_keys_per_s", "op_latency_us"}
+
+
+def test_extract_tolerates_truncated_tail():
+    rec = {"rc": 0, "tail": '{"metric": "x", "val'}  # driver tail cut
+    assert bench_gate.extract_scenarios(rec) == {}
+
+
+def test_direction_rules():
+    assert not bench_gate.lower_is_better("merkle_rebuild", "keys/s")
+    assert not bench_gate.lower_is_better("rep", "events/s (batched)")
+    assert bench_gate.lower_is_better("op_latency_us", "us (GET p50)")
+    assert bench_gate.lower_is_better("cycle_p50_ms", "ms")
+    assert bench_gate.lower_is_better("sync_wire_bytes_1key",
+                                      "bytes (bisect walk)")
+    assert bench_gate.lower_is_better("set_metrics_overhead_pct",
+                                      "% (median)")
+
+
+def test_compare_flags_only_real_regressions():
+    prev = {
+        "throughput": {"value": 100.0, "unit": "keys/s"},
+        "latency": {"value": 10.0, "unit": "ms"},
+        "only_prev": {"value": 1.0, "unit": "ms"},
+    }
+    cur = {
+        "throughput": {"value": 85.0, "unit": "keys/s"},   # -15%: ok
+        "latency": {"value": 11.5, "unit": "ms"},          # +15%: ok
+        "only_cur": {"value": 1.0, "unit": "ms"},
+    }
+    assert bench_gate.compare(prev, cur) == []
+    cur["throughput"]["value"] = 70.0  # -30%: regression
+    cur["latency"]["value"] = 14.0     # +40%: regression
+    lines = bench_gate.compare(prev, cur)
+    assert len(lines) == 2
+    assert any("throughput" in ln for ln in lines)
+    assert any("latency" in ln for ln in lines)
+
+
+def test_main_passes_and_fails(tmp_path, capsys):
+    a = tmp_path / "BENCH_r01.json"
+    b = tmp_path / "BENCH_r02.json"
+    a.write_text(json.dumps(_round(1000.0, [
+        {"metric": "op_latency_us", "value": 10.0, "unit": "us"}])))
+    b.write_text(json.dumps(_round(990.0, [
+        {"metric": "op_latency_us", "value": 11.0, "unit": "us"}])))
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    b.write_text(json.dumps(_round(990.0, [
+        {"metric": "op_latency_us", "value": 30.0, "unit": "us"}])))
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION op_latency_us" in out
+
+
+def test_main_skips_crashed_rounds(tmp_path):
+    """A crashed newest round (rc=1, no scenarios) must not become the
+    baseline OR the candidate; with only one usable round the gate warns
+    and passes."""
+    good = tmp_path / "BENCH_r01.json"
+    bad = tmp_path / "BENCH_r02.json"
+    good.write_text(json.dumps(_round(1000.0, [])))
+    bad.write_text(json.dumps({"rc": 1, "tail": "Traceback ..."}))
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_main_gates_on_committed_rounds_in_repo():
+    """The real committed BENCH_r*.json history must pass the gate (CI
+    runs exactly this)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert bench_gate.main(["--dir", repo]) == 0

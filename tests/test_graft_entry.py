@@ -34,3 +34,25 @@ def test_dryrun_multichip_subprocess():
     # that self-provisions the larger virtual mesh.
     n = len(jax.devices()) * 2
     __graft_entry__.dryrun_multichip(n)
+
+
+def test_multichip_phase_breadcrumbs(tmp_path, monkeypatch, capsys):
+    """The probe leaves per-phase breadcrumbs: flushed stderr lines (the
+    driver's tail capture names the last phase even on a timeout kill)
+    and, with MKV_PHASE_FILE set, an incrementally rewritten JSON sidecar
+    with per-phase wall times."""
+    import json
+
+    phase_file = tmp_path / "phases.json"
+    monkeypatch.setenv("MKV_PHASE_FILE", str(phase_file))
+    __graft_entry__.dryrun_multichip(8)
+    err = capsys.readouterr().err
+    assert "# MULTICHIP PHASE mesh-init" in err
+    assert "# MULTICHIP PHASE spmd-jit-run" in err
+    assert "# MULTICHIP PHASE done" in err
+    doc = json.loads(phase_file.read_text())
+    names = [p["phase"] for p in doc["phases"]]
+    assert names.index("mesh-init") < names.index("spmd-jit-run")
+    assert "serving-tree" in names
+    # Every completed phase carries its wall time.
+    assert all("seconds" in p for p in doc["phases"])

@@ -1,0 +1,305 @@
+"""Convergence-lag SLO plane (obs/lag.py): envelope publish HWMs, the
+per-peer lag gauges, residue clearing on anti-entropy convergence, and
+the /healthz readiness transitions.
+
+Acceptance (ISSUE 7): per-peer ``replication.lag_events`` returns to 0
+after convergence, and ``/healthz`` readiness transitions lagging→live.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.cluster.change_event import (
+    ChangeEvent,
+    OpKind,
+    decode_events,
+    decode_events_meta,
+    encode_batch_cbor,
+    encode_cbor,
+)
+from merklekv_tpu.cluster.node import ClusterNode
+from merklekv_tpu.cluster.transport import TcpBroker
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+from merklekv_tpu.obs.lag import ConvergenceTracker
+
+
+def _ev(key: str, src: str = "peer-a") -> ChangeEvent:
+    return ChangeEvent.new(OpKind.SET, key, b"v", src)
+
+
+# ---------------------------------------------------------- envelope HWM
+
+def test_envelope_carries_hwm_and_trace():
+    events = [_ev("a"), _ev("b")]
+    payload = encode_batch_cbor(
+        events, "peer-a", hwm_seq=17, hwm_ts=123456789,
+        trace="tc=" + "1" * 16 + "-" + "2" * 16 + "-01",
+    )
+    out, meta = decode_events_meta(payload)
+    assert [e.key for e in out] == ["a", "b"]
+    assert meta["src"] == "peer-a"
+    assert meta["hseq"] == 17
+    assert meta["hts"] == 123456789
+    assert meta["tc"].startswith("tc=")
+    # Plain decode_events still works on the stamped envelope.
+    assert len(decode_events(payload)) == 2
+
+
+def test_envelope_without_hwm_stays_compatible():
+    payload = encode_batch_cbor([_ev("a")], "peer-a")
+    out, meta = decode_events_meta(payload)
+    assert len(out) == 1
+    assert meta == {"src": "peer-a"}
+
+
+def test_legacy_single_event_meta():
+    ev = _ev("solo", src="old-node")
+    out, meta = decode_events_meta(encode_cbor(ev))
+    assert [e.key for e in out] == ["solo"]
+    assert meta == {"src": "old-node"}
+
+
+# -------------------------------------------------------------- tracker
+
+def test_tracker_baseline_then_catchup():
+    t = ConvergenceTracker()
+    # First sight mid-stream: baselined, not back-charged.
+    t.on_frame("a", 10, hseq=1000, hts_ns=time.time_ns())
+    assert t.lag_events()["a"] == 10
+    t.on_applied("a", 10, hts_ns=time.time_ns())
+    assert t.lag_events()["a"] == 0
+    assert t.readiness() == "live"
+
+
+def test_tracker_drop_residue_cleared_by_convergence():
+    t = ConvergenceTracker()
+    now = time.time_ns()
+    t.on_frame("a", 5, hseq=5, hts_ns=now)
+    t.on_applied("a", 5, hts_ns=now)
+    # A dropped frame: seen via the NEXT frame's HWM jump.
+    t.on_frame("a", 3, hseq=13, hts_ns=now)  # 5 events never arrived
+    t.on_applied("a", 3, hts_ns=now)
+    assert t.lag_events()["a"] == 5
+    assert t.readiness() == "lagging"
+    # Anti-entropy converged (root comparison): residue is repaired data.
+    t.on_converged()
+    assert t.lag_events()["a"] == 0
+    assert t.readiness() == "live"
+
+
+def test_tracker_diverged_after_persistent_residue():
+    t = ConvergenceTracker(diverged_after_s=0.05)
+    t.on_frame("a", 2, hseq=10, hts_ns=time.time_ns())
+    t.on_applied("a", 2, hts_ns=time.time_ns())
+    t.on_frame("a", 1, hseq=20, hts_ns=time.time_ns())  # gap of 9
+    t.on_applied("a", 1, hts_ns=time.time_ns())
+    assert t.readiness() == "lagging"
+    time.sleep(0.08)
+    assert t.readiness() == "diverged"
+    t.on_converged()
+    assert t.readiness() == "live"
+
+
+def test_tracker_slow_apply_reads_lagging():
+    t = ConvergenceTracker(lag_ms_threshold=1.0)
+    old = time.time_ns() - int(50e6)  # published 50 ms ago
+    t.on_frame("a", 1, hseq=1, hts_ns=old)
+    t.on_applied("a", 1, hts_ns=old)
+    assert t.lag_events()["a"] == 0
+    assert t.lag_ms()["a"] >= 40.0
+    assert t.readiness() == "lagging"
+
+
+def test_tracker_ignores_hwmless_frames():
+    t = ConvergenceTracker()
+    t.on_frame("old", 4)  # legacy publisher: no HWM
+    t.on_applied("old", 4)
+    assert t.lag_events().get("old", 0) == 0
+    assert t.readiness() == "live"
+
+
+# -------------------------------------------------- cluster integration
+
+@pytest.fixture
+def cluster():
+    broker = TcpBroker()
+    topic = f"lag-{uuid.uuid4().hex[:8]}"
+    made = []
+    for name in ("lag-a", "lag-b"):
+        eng = NativeEngine("mem")
+        srv = NativeServer(eng, "127.0.0.1", 0)
+        srv.start()
+        cfg = Config()
+        cfg.replication.enabled = True
+        cfg.replication.mqtt_broker = broker.host
+        cfg.replication.mqtt_port = broker.port
+        cfg.replication.topic_prefix = topic
+        cfg.replication.client_id = name
+        cfg.anti_entropy.engine = "cpu"
+        cfg.observability.http_port = -1
+        # Readiness in this test must hinge on lag RESIDUE alone: the
+        # deliberate apply hold below inflates publish->apply delay, which
+        # must not keep readiness at "lagging" after release on a slow CI.
+        cfg.observability.lag_ms_threshold = 120_000.0
+        node = ClusterNode(cfg, eng, srv)
+        node.start()
+        made.append((eng, srv, node))
+    yield broker, made
+    for eng, srv, node in reversed(made):
+        node.stop()
+        srv.close()
+        eng.close()
+    broker.close()
+
+
+def _healthz(node) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{node.metrics_port}/healthz", timeout=5
+    ) as r:
+        return json.loads(r.read())
+
+
+def _wait(pred, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_lag_returns_to_zero_and_healthz_transitions(cluster):
+    """Tier-1 acceptance: held frames read as per-peer lag_events > 0 and
+    /healthz "lagging"; releasing applies drains the lag to 0 and
+    readiness transitions back to "live"."""
+    broker, made = cluster
+    (eng_a, srv_a, node_a), (eng_b, srv_b, node_b) = made
+
+    node_b.replicator.hold_applies()
+    with MerkleKVClient("127.0.0.1", srv_a.port) as c:
+        for i in range(40):
+            c.set(f"lg:{i:04d}", f"v{i}")
+    assert _wait(
+        lambda: node_b.lag_tracker.lag_events().get("lag-a", 0) >= 40
+    ), node_b.lag_tracker.lag_events()
+    assert node_b.lag_tracker.readiness() == "lagging"
+    hz = _healthz(node_b)
+    assert hz["readiness"] == "lagging"
+    assert hz["lag_events"] >= 40
+
+    node_b.replicator.release_applies()
+    assert _wait(
+        lambda: node_b.lag_tracker.lag_events().get("lag-a", 1) == 0
+    ), node_b.lag_tracker.lag_events()
+    assert _wait(lambda: node_b.lag_tracker.readiness() == "live")
+    assert _healthz(node_b)["readiness"] == "live"
+    # The applied writes actually landed.
+    assert _wait(lambda: eng_b.dbsize() == 40)
+
+    # METRICS wire carries the same numbers for wire-only consumers (top);
+    # the block's contract is integer text, so readiness rides as a code.
+    with MerkleKVClient("127.0.0.1", srv_b.port) as c:
+        m = c.metrics()
+    assert m.get("replication.lag_events.lag-a") == "0"
+    assert "replication.lag_ms.lag-a" in m
+    assert m.get("readiness_code") == "2"
+    assert all(v.lstrip("-").isdigit() for v in m.values()), m
+
+
+def test_convergence_histogram_observed(cluster):
+    broker, made = cluster
+    (eng_a, srv_a, node_a), (eng_b, srv_b, node_b) = made
+    from merklekv_tpu.utils.tracing import get_metrics
+
+    before = get_metrics().histogram("replication.convergence").snapshot()[
+        "count"
+    ]
+    with MerkleKVClient("127.0.0.1", srv_a.port) as c:
+        for i in range(10):
+            c.set(f"cv:{i:03d}", "x")
+    assert _wait(lambda: eng_b.dbsize() >= 10)
+    assert _wait(
+        lambda: get_metrics()
+        .histogram("replication.convergence")
+        .snapshot()["count"]
+        > before
+    )
+
+
+def test_lag_gauges_exported(cluster):
+    broker, made = cluster
+    (eng_a, srv_a, node_a), (eng_b, srv_b, node_b) = made
+    with MerkleKVClient("127.0.0.1", srv_a.port) as c:
+        c.set("gx", "1")
+    assert _wait(lambda: eng_b.dbsize() >= 1)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{node_b.metrics_port}/metrics", timeout=5
+    ) as r:
+        page = r.read().decode()
+    assert 'mkv_replication_lag_events{src="lag-a"}' in page
+    assert 'mkv_replication_lag_ms{src="lag-a"}' in page
+    assert "mkv_node_readiness" in page
+    assert "mkv_replication_convergence_seconds_bucket" in page
+
+
+def test_only_full_clean_pass_clears_residue():
+    """Review hardening: a pairwise pass that could not cover every
+    configured peer (one down) must NOT clear dropped-frame residue —
+    converging with peer A proves nothing about a partitioned peer B's
+    events; a later full clean pass does clear it."""
+    import socket
+
+    from merklekv_tpu.cluster.retry import RetryPolicy
+    from merklekv_tpu.cluster.sync import SyncManager
+
+    tracker = ConvergenceTracker()
+    now = time.time_ns()
+    tracker.on_frame("b", 1, hseq=10, hts_ns=now)
+    tracker.on_applied("b", 1, hts_ns=now)
+    tracker.on_frame("b", 1, hseq=20, hts_ns=now)  # 9 events dropped
+    tracker.on_applied("b", 1, hts_ns=now)
+    assert tracker.lag_events()["b"] == 9
+
+    eng_l = NativeEngine("mem")
+    eng_r = NativeEngine("mem")
+    srv = NativeServer(eng_r, "127.0.0.1", 0)
+    srv.start()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()  # nothing listening: instant ECONNREFUSED
+    fast = RetryPolicy(first_delay=0.01, max_delay=0.02, jitter=0.0,
+                       attempts=1, op_timeout=0.5, op_deadline=5.0)
+    try:
+        up_peer = f"127.0.0.1:{srv.port}"
+        mgr = SyncManager(
+            eng_l, device="cpu", retry=fast,
+            on_cycle_converged=tracker.on_converged,
+        )
+        mgr.start_loop([up_peer, f"127.0.0.1:{dead_port}"], 0.05)
+        time.sleep(0.8)
+        mgr.stop()
+        assert tracker.lag_events()["b"] == 9, "partial pass cleared residue"
+
+        mgr2 = SyncManager(
+            eng_l, device="cpu", retry=fast,
+            on_cycle_converged=tracker.on_converged,
+        )
+        mgr2.start_loop([up_peer], 0.05)
+        deadline = time.time() + 10
+        while time.time() < deadline and tracker.lag_events()["b"] != 0:
+            time.sleep(0.05)
+        mgr2.stop()
+        assert tracker.lag_events()["b"] == 0
+    finally:
+        srv.close()
+        eng_l.close()
+        eng_r.close()

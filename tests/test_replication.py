@@ -808,3 +808,63 @@ def test_outbox_overflow_drops_oldest_and_counts():
         t.link_down = False
         t.close()
         broker.close()
+
+
+def test_replicate_disable_actually_detaches_applier():
+    """Regression: transports remove subscriptions by callback IDENTITY,
+    and ``self._on_message`` is a fresh bound-method object per attribute
+    access — the replicator must subscribe/unsubscribe with ONE pinned
+    object, or a stopped ("REPLICATE disable"d) node keeps applying every
+    inbound frame. Found by end-to-end verification of PR 7."""
+    import uuid as _uuid
+
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.cluster.transport import TcpBroker
+    from merklekv_tpu.config import Config
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    broker = TcpBroker()
+    topic = f"dis-{_uuid.uuid4().hex[:8]}"
+    made = []
+    try:
+        for name in ("dis-a", "dis-b"):
+            eng = NativeEngine("mem")
+            srv = NativeServer(eng, "127.0.0.1", 0)
+            srv.start()
+            cfg = Config()
+            cfg.replication.enabled = True
+            cfg.replication.mqtt_broker = broker.host
+            cfg.replication.mqtt_port = broker.port
+            cfg.replication.topic_prefix = topic
+            cfg.replication.client_id = name
+            cfg.anti_entropy.engine = "cpu"
+            node = ClusterNode(cfg, eng, srv)
+            node.start()
+            made.append((eng, srv, node))
+        (eng_a, srv_a, node_a), (eng_b, srv_b, node_b) = made
+
+        from merklekv_tpu.client import MerkleKVClient
+
+        with MerkleKVClient("127.0.0.1", srv_a.port) as c:
+            c.set("pre", "1")
+        deadline = time.time() + 10
+        while time.time() < deadline and eng_b.dbsize() < 1:
+            time.sleep(0.02)
+        assert eng_b.dbsize() == 1
+
+        with MerkleKVClient("127.0.0.1", srv_b.port) as c:
+            assert c.replicate("disable") == "OK"
+        with MerkleKVClient("127.0.0.1", srv_a.port) as c:
+            for i in range(20):
+                c.set(f"post:{i}", "x")
+        deadline = time.time() + 10
+        while time.time() < deadline and eng_a.dbsize() < 21:
+            time.sleep(0.02)
+        time.sleep(0.5)  # give any (buggy) residual subscription a window
+        assert eng_b.dbsize() == 1, "disabled node still applied frames"
+    finally:
+        for eng, srv, node in reversed(made):
+            node.stop()
+            srv.close()
+            eng.close()
+        broker.close()

@@ -1,0 +1,467 @@
+"""Causal tracing (obs/tracewire.py): token grammar, span collection,
+cross-node stitching, fault tolerance of the stitch, capability fallback
+against pre-tracing peers, and the Perfetto assembly + CLI.
+
+The acceptance case (ISSUE 7): a 3-node cycle produces ONE stitched,
+Perfetto-loadable trace with spans from BOTH peers under one trace id —
+and fault injection on the link can orphan spans but never mis-parent
+them.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+
+import pytest
+
+from merklekv_tpu.client import MerkleKVClient
+from merklekv_tpu.cluster.node import ClusterNode
+from merklekv_tpu.cluster.retry import RetryPolicy
+from merklekv_tpu.cluster.sync import SyncManager
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+from merklekv_tpu.obs import tracewire
+from merklekv_tpu.testing.faults import FaultInjector
+from merklekv_tpu.utils.tracing import span
+
+FAST = RetryPolicy(
+    first_delay=0.01, max_delay=0.05, jitter=0.0, attempts=2,
+    op_timeout=0.5, op_deadline=30.0,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_collector():
+    tracewire.get_collector().clear()
+    tracewire.set_propagation(True)
+    yield
+    tracewire.get_collector().clear()
+
+
+# ------------------------------------------------------------------ token
+
+def test_token_roundtrip():
+    ctx = tracewire.new_context()
+    tok = ctx.token()
+    assert len(tok) == 39 and tok.startswith("tc=")
+    back = tracewire.parse_token(tok)
+    assert back == ctx
+
+
+def test_parse_token_rejects_malformed():
+    good = tracewire.new_context().token()
+    bad = [
+        "", "tc=", good[:-1], good + "0", good.replace("-", "_", 1),
+        "tc=" + "g" * 16 + "-" + "0" * 16 + "-01",  # non-hex
+        "tc=" + "0" * 16 + "-" + "0" * 16 + "-01",  # zero ids
+        good.replace("tc=", "tx="),
+    ]
+    for tok in bad:
+        assert tracewire.parse_token(tok) is None, tok
+
+
+def test_child_keeps_trace_id():
+    ctx = tracewire.new_context()
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+
+
+# -------------------------------------------------------------- collector
+
+def test_collector_ring_and_wire_dump():
+    col = tracewire.SpanCollector(capacity=16)
+    for i in range(40):
+        col.record(trace_id=1, span_id=i + 1, parent_id=0,
+                   name=f"s{i}", role="initiator", ts_ns=i, dur_ns=1)
+    assert len(col) == 16
+    dump = col.wire_dump(0)
+    assert dump.startswith("SPANS 16\r\n") and dump.endswith("END\r\n")
+    # Newest-n selection keeps the tail.
+    assert "name=s39" in col.wire_dump(1)
+    assert col.wire_dump(1).startswith("SPANS 1\r\n")
+
+
+def test_span_records_nested_parenting():
+    ctx = tracewire.new_context()
+    with tracewire.trace_scope(ctx):
+        with span("outer"):
+            with span("inner"):
+                pass
+    spans = tracewire.get_collector().spans()
+    by_name = {s.name: s for s in spans}
+    assert set(by_name) == {"outer", "inner"}
+    assert by_name["outer"].trace_id == ctx.trace_id
+    assert by_name["outer"].parent_id == ctx.span_id  # trace root
+    assert by_name["inner"].parent_id == by_name["outer"].span_id
+
+
+def test_span_records_nothing_untraced():
+    with span("plain"):
+        pass
+    assert len(tracewire.get_collector()) == 0
+
+
+# ---------------------------------------------------------------- assembly
+
+def _rows_of(spans):
+    return [
+        dict(
+            trace=f"{s.trace_id:016x}", span=f"{s.span_id:016x}",
+            parent=f"{s.parent_id:016x}", name=s.name, role=s.role,
+            ts_ns=str(s.ts_ns), dur_ns=str(s.dur_ns),
+            node=s.node or "-", cycle=str(s.cycle),
+        )
+        for s in spans
+    ]
+
+
+def test_orphans_flagged_never_misparented():
+    tid = 7
+    rows = _rows_of([
+        tracewire.SpanRecord(tid, 1, tid, "root-child", "initiator", 10, 5),
+        tracewire.SpanRecord(tid, 2, 1, "child", "donor", 11, 2),
+        tracewire.SpanRecord(tid, 3, 999, "lost-parent", "donor", 12, 2),
+    ])
+    traces = tracewire.stitch([("n1", rows)])
+    spans = traces[tid]
+    orphans = tracewire.orphan_spans(spans)
+    assert orphans == {3}
+    doc = tracewire.chrome_trace_events(traces)
+    by_span = {
+        e["args"]["span_id"]: e
+        for e in doc["traceEvents"]
+        if e.get("ph") == "X"
+    }
+    assert by_span[f"{3:016x}"]["args"]["orphan"] is True
+    # The orphan keeps its ORIGINAL (absent) parent id — never re-pointed.
+    assert by_span[f"{3:016x}"]["args"]["parent"] == f"{999:016x}"
+    assert "orphan" not in by_span[f"{1:016x}"]["args"]
+    assert "orphan" not in by_span[f"{2:016x}"]["args"]
+
+
+def test_stitch_dedupes_and_skips_malformed():
+    s = tracewire.SpanRecord(5, 1, 5, "a", "initiator", 1, 1)
+    rows = _rows_of([s])
+    garbage = [{"trace": "zz", "span": "1"}, {"name": "no-ids"}]
+    traces = tracewire.stitch(
+        [("n1", rows + garbage), ("n2", rows)]  # duplicate span from n2
+    )
+    assert len(traces[5]) == 1
+
+
+# ------------------------------------------------------- wire integration
+
+@pytest.fixture
+def donor_pair():
+    """Two donor nodes (cluster plane attached) + their engines."""
+    made = []
+    for _ in range(2):
+        eng = NativeEngine("mem")
+        srv = NativeServer(eng, "127.0.0.1", 0)
+        srv.start()
+        cfg = Config()
+        cfg.anti_entropy.engine = "cpu"
+        node = ClusterNode(cfg, eng, srv)
+        node.start()
+        made.append((eng, srv, node))
+    yield made
+    for eng, srv, node in reversed(made):
+        node.stop()
+        srv.close()
+        eng.close()
+
+
+def test_three_node_cycle_stitches_both_peers(donor_pair):
+    """Acceptance: one multi-peer anti-entropy cycle yields ONE trace id
+    carrying initiator spans AND donor serve spans from BOTH peers, and
+    the assembled document is valid Chrome trace JSON."""
+    (eng_a, srv_a, _na), (eng_b, srv_b, _nb) = donor_pair
+    eng_i = NativeEngine("mem")
+    try:
+        for i in range(50):
+            eng_a.set(b"t3:%04d" % i, b"va-%d" % i)
+            eng_b.set(b"t3:%04d" % i, b"vb-newer-%d" % i)
+        mgr = SyncManager(eng_i, device="cpu", retry=FAST)
+        report = mgr.sync_multi(
+            [f"127.0.0.1:{srv_a.port}", f"127.0.0.1:{srv_b.port}"]
+        )
+        assert report.union_keys == 50
+
+        # Stitch exactly as the CLI does: TRACEDUMP over the wire from
+        # both donors (they share this process's collector; stitch
+        # dedupes), newest trace = this cycle.
+        dumps = []
+        for port in (srv_a.port, srv_b.port):
+            with MerkleKVClient("127.0.0.1", port) as c:
+                dumps.append((f"127.0.0.1:{port}", c.trace_dump(0)))
+        traces = tracewire.stitch(dumps)
+        assert traces, "no traces collected"
+        tid, spans = max(
+            traces.items(), key=lambda kv: max(s.ts_ns for s in kv[1])
+        )
+        roles = {s.role for s in spans}
+        assert "initiator" in roles and "donor" in roles
+        donor_nodes = {s.node for s in spans if s.role == "donor"}
+        assert donor_nodes == {
+            f"127.0.0.1:{srv_a.port}", f"127.0.0.1:{srv_b.port}"
+        }
+        assert tracewire.orphan_spans(spans) == set()
+        # Perfetto-loadable: serializable, complete events, pid metadata.
+        doc = tracewire.chrome_trace_events({tid: spans})
+        payload = json.loads(json.dumps(doc))
+        assert payload["traceEvents"]
+        phases = {e["ph"] for e in payload["traceEvents"]}
+        assert phases <= {"X", "M"}
+        names = {e["name"] for e in payload["traceEvents"]}
+        assert "serve.leafhashes" in names
+    finally:
+        eng_i.close()
+
+
+def test_trace_cli_writes_chrome_json(donor_pair, tmp_path):
+    (eng_a, srv_a, _na), (eng_b, srv_b, _nb) = donor_pair
+    eng_i = NativeEngine("mem")
+    try:
+        for i in range(20):
+            eng_a.set(b"cli:%03d" % i, b"x")
+        mgr = SyncManager(eng_i, device="cpu", retry=FAST)
+        mgr.sync_once("127.0.0.1", srv_a.port)
+        out = tmp_path / "trace.json"
+        rc = tracewire.main([
+            "--nodes",
+            f"127.0.0.1:{srv_a.port},127.0.0.1:{srv_b.port}",
+            "--cycles", "1",
+            "--out", str(out),
+        ])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["traceEvents"]
+    finally:
+        eng_i.close()
+
+
+def test_tracedump_without_cluster_plane_is_empty():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    try:
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            assert c.trace_dump() == []
+    finally:
+        srv.close()
+        eng.close()
+
+
+# ------------------------------------------------------ faults / fallback
+
+def _stitch_local():
+    spans = tracewire.get_collector().spans()
+    return tracewire.stitch([("local", _rows_of(spans))])
+
+
+@pytest.mark.parametrize("faults", [
+    dict(drop_rate=0.08),
+    dict(truncate_rate=0.08),
+    dict(reorder_rate=0.25, delay=(0.001, 0.003)),
+])
+def test_traced_sync_stitching_survives_faults(faults):
+    """Chaos: drop/truncate/reorder on a traced pairwise cycle must never
+    corrupt stitching — spans either parent under a present span / the
+    trace root, or are FLAGGED orphans; a span never dangles under a
+    wrong parent, and assembly never raises."""
+    local = NativeEngine("mem")
+    remote = NativeEngine("mem")
+    srv = NativeServer(remote, "127.0.0.1", 0)
+    srv.start()
+    cfg = Config()
+    cfg.anti_entropy.engine = "cpu"
+    node = ClusterNode(cfg, remote, srv)
+    node.start()
+    inj = FaultInjector("127.0.0.1", srv.port, seed=1234)
+    inj.set_faults("both", **faults)
+    try:
+        for i in range(300):
+            remote.set(b"f:%05d" % i, b"fresh-%d" % i)
+            if i % 3:
+                local.set(b"f:%05d" % i, b"stale")
+        # Bounded cycles under a TIGHT deadline (convergence under faults
+        # is test_faults.py's job; THIS test's bar is stitch integrity):
+        # a reordered stream desyncs the protocol and burns op timeouts
+        # per cycle, so a converge-or-bust loop would take minutes.
+        tight = RetryPolicy(
+            first_delay=0.01, max_delay=0.05, jitter=0.0, attempts=2,
+            op_timeout=0.25, op_deadline=3.0,
+        )
+        mgr = SyncManager(
+            local, device="cpu", retry=tight, hash_page=32, mget_batch=16
+        )
+        for _ in range(8):
+            try:
+                mgr.sync_once(inj.host, inj.port)
+            except Exception:
+                continue
+            if local.merkle_root() == remote.merkle_root():
+                break
+        traces = _stitch_local()
+        assert traces, "no spans recorded under faults"
+        for tid, spans in traces.items():
+            ids = {s.span_id for s in spans}
+            orphans = tracewire.orphan_spans(spans)
+            for s in spans:
+                assert s.trace_id == tid
+                assert s.span_id != s.parent_id
+                ok_parent = (
+                    s.parent_id == tid  # trace root
+                    or s.parent_id in ids
+                    or s.span_id in orphans
+                )
+                assert ok_parent, (s.name, s.role)
+            # Assembly never raises and flags exactly the orphans.
+            doc = tracewire.chrome_trace_events({tid: spans})
+            flagged = {
+                int(e["args"]["span_id"], 16)
+                for e in doc["traceEvents"]
+                if e.get("ph") == "X" and e["args"].get("orphan")
+            }
+            assert flagged == orphans
+    finally:
+        inj.close()
+        node.stop()
+        srv.close()
+        local.close()
+        remote.close()
+
+
+class _OldPeer:
+    """Canned pre-tracing server: rejects a 4th TREELEVEL token with the
+    old parser's arity error, serves the plain form — and records every
+    request line so the test can assert what actually hit the wire."""
+
+    def __init__(self) -> None:
+        self.lines: list[str] = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        buf = b""
+        with conn:
+            while True:
+                try:
+                    data = conn.recv(4096)
+                except OSError:
+                    return
+                if not data:
+                    return
+                buf += data
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    text = line.decode().strip()
+                    self.lines.append(text)
+                    toks = text.split()
+                    if toks and toks[0] == "TREELEVEL":
+                        if len(toks) != 4:
+                            resp = ("ERROR TREELEVEL requires arguments: "
+                                    "<level> <lo> <hi>\r\n")
+                        else:
+                            resp = "NODES 0 5\r\n"
+                    elif toks and toks[0] == "LEAFHASHES":
+                        # Old parser: ONE optional arg = prefix. A token
+                        # here would silently filter to the tc= prefix —
+                        # the exact hazard the settled-capability rule
+                        # prevents; answer per old semantics.
+                        resp = "HASHES 0\r\n"
+                    else:
+                        resp = "ERROR Unknown command\r\n"
+                    conn.sendall(resp.encode())
+
+    def close(self) -> None:
+        self._srv.close()
+
+
+def test_capability_fallback_against_untraced_peer():
+    peer = _OldPeer()
+    try:
+        c = MerkleKVClient("127.0.0.1", peer.port, timeout=2.0)
+        c.trace_provider = tracewire.current_token
+        c.connect()
+        with tracewire.trace_scope(tracewire.new_context()):
+            rows, n = c.tree_level(0, 0, 0)
+            assert (rows, n) == ([], 5)
+            assert c._peer_traced is False
+            # Second traced verb goes straight to the plain form.
+            rows, n = c.tree_level(0, 0, 0)
+            assert (rows, n) == ([], 5)
+            # LEAFHASHES never carries a token on an unproven (or
+            # fallen-back) connection.
+            assert c.leaf_hashes_ts() == {}
+        c.close()
+        treelevels = [ln for ln in peer.lines if ln.startswith("TREELEVEL")]
+        assert len(treelevels) == 3  # traced try + plain retry + plain
+        assert sum("tc=" in ln for ln in treelevels) == 1
+        leaf = [ln for ln in peer.lines if ln.startswith("LEAFHASHES")]
+        assert leaf == ["LEAFHASHES"]
+    finally:
+        peer.close()
+
+
+def test_leafhashes_token_attaches_only_after_settled():
+    """On a NEW server the walk settles capability via TREELEVEL, after
+    which LEAFHASHES carries the token too."""
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    cfg = Config()
+    cfg.anti_entropy.engine = "cpu"
+    node = ClusterNode(cfg, eng, srv)
+    node.start()
+    try:
+        eng.set(b"k", b"v")
+        c = MerkleKVClient("127.0.0.1", srv.port, timeout=2.0)
+        c.trace_provider = tracewire.current_token
+        c.connect()
+        with tracewire.trace_scope(tracewire.new_context()):
+            c.leaf_hashes_ts()  # unsettled: plain form, no donor span
+            assert c._peer_traced is None
+            c.tree_level(0, 0, 0)  # settles capability
+            assert c._peer_traced is True
+            c.leaf_hashes_ts()  # now traced
+        c.close()
+        names = [s.name for s in tracewire.get_collector().spans()]
+        assert names.count("serve.leafhashes") == 1
+        assert "serve.treelevel" in names
+    finally:
+        node.stop()
+        srv.close()
+        eng.close()
+
+
+def test_propagation_off_sends_no_tokens(donor_pair):
+    (eng_a, srv_a, _na), _ = donor_pair
+    eng_i = NativeEngine("mem")
+    tracewire.set_propagation(False)
+    try:
+        for i in range(10):
+            eng_a.set(b"off:%03d" % i, b"x")
+        mgr = SyncManager(eng_i, device="cpu", retry=FAST)
+        mgr.sync_once("127.0.0.1", srv_a.port)
+        assert len(tracewire.get_collector()) == 0
+    finally:
+        tracewire.set_propagation(True)
+        eng_i.close()
