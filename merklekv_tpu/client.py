@@ -42,11 +42,35 @@ class ChunkIntegrityError(MerkleKVError):
     bootstrap fetch retries the same offset instead of failing the donor."""
 
 
+class ServerBusyError(ProtocolError):
+    """The node shed this request under overload (``ERROR BUSY ...``):
+    admission control refused the connection, or a write was shed above a
+    memory/disk watermark. RETRYABLE — the condition is transient by
+    design (the degradation ladder steps back down once the resource
+    recovers); back off and retry (cluster/retry.py treats it so)."""
+
+
+class ReadOnlyError(ProtocolError):
+    """The node refused a write because it is read-only (``ERROR READONLY
+    ...``): hard memory watermark, full/failing disk, or draining for
+    shutdown. NOT usefully retryable on the same node until it recovers —
+    route writes elsewhere or wait for /healthz to return to live."""
+
+
 # --------------------------------------------------------------- parsing
 
 def _parse_simple(resp: str) -> str:
     if resp.startswith("ERROR "):
-        raise ProtocolError(resp[6:])
+        msg = resp[6:]
+        # Overload-protection answers are TYPED so callers can tell a
+        # retryable shed (BUSY) from a wait-for-recovery refusal
+        # (READONLY) without string-matching; both subclass ProtocolError
+        # so existing handlers keep working.
+        if msg.startswith("BUSY"):
+            raise ServerBusyError(msg)
+        if msg.startswith("READONLY"):
+            raise ReadOnlyError(msg)
+        raise ProtocolError(msg)
     return resp
 
 

@@ -11,6 +11,8 @@ Host-side subsystems around the native server and the TPU Merkle data plane:
 - ``replicator``: drains native write events, publishes, applies remote
 - ``sync``: anti-entropy manager — batched snapshot exchange + TPU diff
   (reference sync.rs, minus its per-key-TCP-connection hot loop)
+- ``overload``: degradation ladder + watermark monitor (overload
+  protection; the native server enforces the pushed level)
 - ``node``: wires everything to a running native server
 """
 

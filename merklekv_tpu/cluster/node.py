@@ -14,6 +14,14 @@ import threading
 import time
 from typing import Optional
 
+from merklekv_tpu.cluster.overload import (
+    DRAINING,
+    LEVEL_NAMES,
+    REASON_CODES,
+    SHEDDING,
+    DegradationLadder,
+    OverloadMonitor,
+)
 from merklekv_tpu.cluster.replicator import Replicator
 from merklekv_tpu.cluster.sync import SyncManager
 from merklekv_tpu.cluster.transport import Transport, make_transport
@@ -59,6 +67,11 @@ class ClusterNode:
         # One PROFILE capture at a time; directory returned on start.
         self._profile_mu = threading.Lock()
         self._profiling = False
+        # Overload-protection plane: the node-wide degradation ladder
+        # (live -> shedding -> read_only -> draining), fed by the memory /
+        # disk watermark monitor and enforced by the native server.
+        self.ladder = DegradationLadder()
+        self._overload: Optional[OverloadMonitor] = None
         self.sync_manager = SyncManager(
             engine,
             device=cfg.anti_entropy.engine,
@@ -67,11 +80,28 @@ class ClusterNode:
             mode=cfg.anti_entropy.mode,
             bisect_threshold=cfg.anti_entropy.bisect_threshold,
             on_cycle_converged=self.lag_tracker.on_converged,
+            max_skew_ms=cfg.replication.max_skew_ms,
         )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._server.set_cluster_handler(self._on_cluster_command)
+        # Overload protection BEFORE anything serves: admission limits go
+        # to the native accept path, and the watermark monitor starts
+        # pushing the degradation ladder (its first poll runs inline, so
+        # a node restarted over a full disk comes up read-only, not live).
+        self._server.set_limits(
+            self._cfg.server.max_connections, self._cfg.server.max_pipeline
+        )
+        self._overload = OverloadMonitor(
+            self.ladder,
+            self._engine,
+            self._server,
+            self._cfg.server,
+            storage=self._storage,
+        ).start()
+        if self._storage is not None:
+            self._storage.set_defer_compaction(self._overload.memory_pressure)
         self._register_gauges()
         from merklekv_tpu.obs.trace import get_trace_buffer
 
@@ -150,10 +180,22 @@ class ClusterNode:
                 self._cfg.anti_entropy.interval_seconds,
                 multi_peer=self._cfg.anti_entropy.multi_peer,
                 peer_up=self._health.is_up if self._health else None,
+                pause_when=(
+                    self._overload.should_pause_background
+                    if self._overload is not None
+                    else None
+                ),
             )
 
     def stop(self) -> None:
         self._stopped = True
+        # Draining is the ladder's top rung: new connections are refused
+        # BUSY and writes answer READONLY while the teardown (final WAL
+        # drain, shutdown snapshot) runs. The monitor stops first so it
+        # cannot race the rung back down.
+        if self._overload is not None:
+            self._overload.stop()
+        self._server.set_degradation(DRAINING, REASON_CODES["draining"])
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
@@ -172,6 +214,10 @@ class ClusterNode:
             self._transport.close()
             self._transport = None
         self._server.set_cluster_handler(None)
+        # Back to live: embedded/test shapes reuse the native server after
+        # a node stops (the process-level path closes it right after, so
+        # the draining window there lasts until server.close()).
+        self._server.set_degradation(0, 0)
 
     @property
     def replicator(self) -> Optional[Replicator]:
@@ -231,6 +277,7 @@ class ClusterNode:
                     batch_max_events=self._cfg.replication.batch_max_events,
                     batch_max_bytes=self._cfg.replication.batch_max_bytes,
                     lag_tracker=self.lag_tracker,
+                    max_skew_ms=self._cfg.replication.max_skew_ms,
                 )
                 self._replicator.start()
             except Exception as e:
@@ -551,6 +598,13 @@ class ClusterNode:
             return {"keys": -1, "readiness": "diverged"}
         payload = {"keys": self._engine.dbsize(), "port": self._server.port}
         payload["readiness"] = self.lag_tracker.readiness()
+        # Overload plane: the degradation rung, and a degraded status the
+        # moment the node sheds anything — a load balancer must see a
+        # shedding/read-only node as unhealthy-for-writes immediately.
+        level = self.ladder.level()
+        payload["degradation"] = LEVEL_NAMES.get(level, "live")
+        if level >= SHEDDING:
+            payload["status"] = "degraded"
         lag = self.lag_tracker.lag_events()
         if lag:
             payload["lag_events"] = sum(lag.values())
@@ -638,6 +692,9 @@ class ClusterNode:
              "peer (ms; cross-host clock skew applies).", "src"),
             ("node.readiness", tracker.readiness_code,
              "Convergence readiness (2=live 1=lagging 0=diverged).", ""),
+            ("node.degradation", self.ladder.level,
+             "Overload degradation ladder (0=live 1=shedding 2=read_only "
+             "3=draining).", ""),
         ]
         if self._storage is not None:
             storage = self._storage
@@ -706,6 +763,30 @@ class ClusterNode:
         for src, v in sorted(self.lag_tracker.lag_ms().items()):
             lines.append(f"replication.lag_ms.{src}:{int(round(v))}")
         lines.append(f"readiness_code:{self.lag_tracker.readiness_code()}")
+        # Overload plane: the ladder rung plus the native shed counters
+        # (one stats_text read), so wire-only consumers (top's STATE and
+        # SHED/s columns) see overload state without scraping /metrics.
+        lines.append(f"node.degradation:{self.ladder.level()}")
+        try:
+            stats: dict[str, str] = {}
+            for ln in self._server.stats_text().splitlines():
+                name, _, value = ln.strip().partition(":")
+                stats[name] = value
+            shed = sum(
+                int(stats.get(k, 0) or 0)
+                for k in (
+                    "shed_commands",
+                    "busy_rejected_connections",
+                    "pipeline_rejected",
+                )
+            )
+            lines.append(f"node.shed_total:{shed}")
+            lines.append(
+                "node.readonly_rejected:"
+                f"{int(stats.get('readonly_commands', 0) or 0)}"
+            )
+        except Exception:
+            pass  # a dead server handle drops the shed lines, not METRICS
         body = "".join(f"{ln}\r\n" for ln in lines)
         return f"METRICS\r\n{body}END\r\n"
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 import time
 import uuid
+from dataclasses import replace as dc_replace
 from typing import Callable, Optional
 
 from merklekv_tpu.cluster.applier import LWWApplier
@@ -100,6 +101,7 @@ class Replicator:
         batch_max_events: int = 512,
         batch_max_bytes: int = 1 << 20,
         lag_tracker=None,  # Optional[obs.lag.ConvergenceTracker]
+        max_skew_ms: int = 0,
     ) -> None:
         self._engine = engine
         self._server = server
@@ -162,6 +164,15 @@ class Replicator:
         # per-peer lag gauges through this tracker.
         self._lag = lag_tracker
         self._pub_seq = 0
+        # LWW clock-skew guard ([replication] max_skew_ms): an inbound
+        # event stamped further than this beyond the local clock is
+        # CLAMPED to now + skew BEFORE it is journaled or applied. Under
+        # raw LWW a single poisoned future timestamp (one peer with a
+        # misconfigured clock) fences its key against every honest writer
+        # FOREVER; with the clamp the damage is bounded by the skew
+        # window, after which normal writes win again. 0 disables.
+        self._max_skew_ns = max(0, int(max_skew_ms)) * 1_000_000
+        self.skew_clamped = 0
         # Bootstrap hold: while set, inbound frames JOURNAL (the WAL must
         # never gap) but defer their engine/mirror apply until the verified
         # snapshot is installed — then they replay in arrival order through
@@ -374,6 +385,7 @@ class Replicator:
         events = [ev for ev in events if ev.src != self.node_id]  # no echo
         if not events:
             return
+        events = self._clamp_skew(events)
         self.received += len(events)
         get_metrics().inc("replicator.received", len(events))
         if self._lag is not None:
@@ -416,6 +428,31 @@ class Replicator:
                                       len(events))
                 return
             self._apply_frame(events, journal=True, meta=meta)
+
+    def _clamp_skew(self, events: list[ChangeEvent]) -> list[ChangeEvent]:
+        """Clamp future-poisoned timestamps to now + max_skew_ms, counted
+        with per-peer attribution (``replicator.skew_clamped.<src>``) so a
+        misconfigured clock is findable, not just survived. Runs BEFORE
+        journal/hold/apply — the WAL must never persist the poison."""
+        if not self._max_skew_ns:
+            return events
+        limit = time.time_ns() + self._max_skew_ns
+        clamped_by_src: dict[str, int] = {}
+        out = events
+        for i, ev in enumerate(events):
+            if ev.ts > limit:
+                if out is events:
+                    out = list(events)
+                out[i] = dc_replace(ev, ts=limit)
+                clamped_by_src[ev.src] = clamped_by_src.get(ev.src, 0) + 1
+        if clamped_by_src:
+            total = sum(clamped_by_src.values())
+            self.skew_clamped += total
+            m = get_metrics()
+            m.inc("replicator.skew_clamped", total)
+            for src, n in clamped_by_src.items():
+                m.inc(f"replicator.skew_clamped.{src or 'unknown'}", n)
+        return out
 
     def _apply_frame(
         self,

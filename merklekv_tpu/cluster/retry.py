@@ -39,6 +39,8 @@ __all__ = [
     "HEALTH_PROBE",
     "REPLICATOR_PUBLISH",
     "BOOTSTRAP_FETCH",
+    "SERVER_BUSY",
+    "RETRYABLE_ERRORS",
 ]
 
 T = TypeVar("T")
@@ -194,3 +196,24 @@ BOOTSTRAP_FETCH = RetryPolicy(
     op_timeout=30.0,
     op_deadline=600.0,
 )
+
+# Overload shed (ERROR BUSY -> client.ServerBusyError): the server asked
+# for backoff, so the first retry waits a real beat (not the near-
+# immediate transport-hiccup retry) and the window stays bounded — a node
+# still shedding after ~6 tries across a few seconds is genuinely
+# overloaded, and the caller should surface that, not hammer it. NOT for
+# ReadOnlyError: read-only means wait-for-recovery, and retrying it would
+# just re-ask a node that already said it cannot.
+SERVER_BUSY = RetryPolicy(
+    first_delay=0.1, max_delay=1.0, jitter=0.3, attempts=6, op_timeout=5.0
+)
+
+
+# The classification retry-driven callers pass as ``retry_on``: transient
+# transport failures AND the server's explicit shed answer. ReadOnlyError
+# is deliberately absent (see SERVER_BUSY above) — a read-only node asked
+# callers to WAIT, not to hammer it.
+from merklekv_tpu.client import ServerBusyError  # noqa: E402 (no cycle:
+# client.py imports nothing from this package)
+
+RETRYABLE_ERRORS: tuple[type[BaseException], ...] = (OSError, ServerBusyError)

@@ -212,6 +212,13 @@ class SyncManager:
         mode: str = "auto",
         bisect_threshold: int = 8192,
         on_cycle_converged: Optional[Callable[[], None]] = None,
+        # LWW clock-skew guard at the repair-install boundary, same bound
+        # as the replicator's ([replication] max_skew_ms). Without it a
+        # future-poisoned timestamp clamped on the replication path would
+        # simply RE-ENTER through anti-entropy: the poisoning peer still
+        # holds the raw ts in its engine, and a walk/arbitration against
+        # it would install that ts here, re-fencing the key. 0 disables.
+        max_skew_ms: int = 0,
     ) -> None:
         self._engine = engine
         self._device = device
@@ -249,6 +256,7 @@ class SyncManager:
         # missing them too), so firing per cycle would mask exactly the
         # divergence the SLO exists to surface.
         self._on_cycle_converged = on_cycle_converged
+        self._max_skew_ns = max(0, int(max_skew_ms)) * 1_000_000
         self._sessions: dict[str, SyncSession] = {}
         # First-checkpoint time per peer, surviving resume/re-checkpoint
         # churn: a re-checkpoint builds a fresh SyncSession, and without
@@ -1369,7 +1377,24 @@ class SyncManager:
                 self._repair_delete(k)
                 report.deleted_keys += 1
 
+    def _clamp_ts(self, ts: Optional[int]) -> Optional[int]:
+        """Clock-skew guard for adopted peer timestamps: clamp anything
+        beyond now + max_skew_ms BEFORE install/journal, mirroring the
+        replicator's inbound clamp — anti-entropy must not re-import the
+        poison the replication path already refused. Counted
+        (``anti_entropy.skew_clamped``); clamping never changes WHO wins
+        an arbitration (comparisons already happened), only how far into
+        the future the installed fence reaches."""
+        if ts is None or not self._max_skew_ns:
+            return ts
+        limit = time.time_ns() + self._max_skew_ns
+        if ts <= limit:
+            return ts
+        get_metrics().inc("anti_entropy.skew_clamped")
+        return limit
+
     def _repair_set(self, k: bytes, v: bytes, ts: Optional[int] = None) -> None:
+        ts = self._clamp_ts(ts)
         if ts is None:
             self._engine.set(k, v)
         else:
@@ -1380,6 +1405,7 @@ class SyncManager:
     def _repair_set_lww(self, k: bytes, v: bytes, ts: int) -> bool:
         """Conditional install for multi-peer repair: a local write or
         deletion racing ahead of the fetched winner must not be clobbered."""
+        ts = self._clamp_ts(ts)
         applied = self._engine.set_if_newer(k, v, ts)
         if applied and self._repair_listener is not None:
             self._repair_listener(k, v, ts)
@@ -1390,6 +1416,7 @@ class SyncManager:
         (the deletion keeps its LWW position); without one this is a MIRROR
         copy of absence — delete_quiet, because fabricating a tombstone at
         "now" would later kill disjoint writes cluster-wide."""
+        tomb_ts = self._clamp_ts(tomb_ts)
         if tomb_ts is None:
             if not hasattr(self._engine, "delete_quiet"):
                 self._engine.delete(k)  # engine doubles without quiet mode
@@ -1410,6 +1437,7 @@ class SyncManager:
         installed it mid-cycle, and the device mirror must drop what the
         engine just dropped (apply_one(k, None) is a no-op for absent
         keys). ``was_present`` only scopes the report count."""
+        ts = self._clamp_ts(ts)
         applied = self._engine.delete_if_newer(k, ts)
         if applied and self._repair_listener is not None:
             self._repair_listener(k, None, ts)
@@ -1845,6 +1873,7 @@ class SyncManager:
         interval_seconds: float,
         multi_peer: bool = False,
         peer_up=None,  # Callable[[str], bool] from the health monitor
+        pause_when=None,  # Callable[[], bool] from the overload monitor
     ) -> None:
         """Periodic anti-entropy: pairwise per peer, or one fused
         multi-peer arbitration cycle when ``multi_peer`` is set.
@@ -1852,6 +1881,15 @@ class SyncManager:
         ``peer_up`` (the failure detector's verdict) lets a cycle skip
         confirmed-down peers instead of paying a connect timeout each; the
         monitor keeps probing, so a recovered peer rejoins the next cycle.
+
+        ``pause_when`` (the overload monitor's verdict) defers whole
+        cycles while the node is above a resource watermark: a sync cycle
+        materializes leaf maps and repair batches, exactly the allocation
+        a memory-pressured node must not make, and journals repairs a
+        disk-full node cannot. Deferred cycles are counted
+        (``anti_entropy.overload_skips``) and never fire the converged
+        hook — lag residue stays visible until a real full pass runs
+        after recovery.
         """
 
         def up(peer: str) -> bool:
@@ -1864,6 +1902,14 @@ class SyncManager:
 
         def run() -> None:
             while not self._stop.wait(interval_seconds):
+                if pause_when is not None:
+                    try:
+                        paused = bool(pause_when())
+                    except Exception:
+                        paused = False  # a broken monitor must not stall
+                    if paused:
+                        get_metrics().inc("anti_entropy.overload_skips")
+                        continue
                 live_peers = [p for p in peers if up(p)]
                 skipped = len(peers) - len(live_peers)
                 if skipped:

@@ -118,6 +118,39 @@ def _toml_load(f) -> dict:
 
 
 @dataclass
+class ServerConfig:
+    """[server]: overload protection / admission control (cluster/
+    overload.py + the native server's accept/dispatch path).
+
+    All watermarks default OFF (0) — a bare node behaves exactly like the
+    seed. When set, the node walks the degradation ladder (live ->
+    shedding -> read_only -> draining) instead of exhausting threads, RAM,
+    or disk. See docs/FAULT_MODEL.md "Resource exhaustion" and
+    docs/DEPLOYMENT.md for capacity planning.
+    """
+
+    # Accepted-connection cap: past it, excess accepts are answered
+    # "ERROR BUSY connections retry" and closed WITHOUT spawning a handler
+    # thread. 0 = unlimited.
+    max_connections: int = 0
+    # One connection's in-flight pipelined-command budget: a client that
+    # buffers more unanswered complete lines than this is answered BUSY
+    # and closed. 0 = unlimited (default — deep pipelining is a
+    # legitimate throughput pattern; cap it per deployment).
+    max_pipeline: int = 0
+    # Engine resident-bytes watermarks (approximate keys+values bytes,
+    # O(1) to read). soft: shed writes with a retryable BUSY (reads stay
+    # open); hard: flip read-only. 0 disables each.
+    memory_soft_bytes: int = 0
+    memory_hard_bytes: int = 0
+    # Hysteresis: a watermark only releases once the signal falls below
+    # watermark * recovery_ratio — no BUSY/OK flapping at the boundary.
+    recovery_ratio: float = 0.85
+    # Overload-monitor poll cadence.
+    watermark_interval_seconds: float = 0.25
+
+
+@dataclass
 class ReplicationConfig:
     enabled: bool = False
     # Broker endpoint for WAN replication; "local" selects the in-process
@@ -141,6 +174,12 @@ class ReplicationConfig:
     # replicated_write_throughput bench A/Bs against).
     batch_max_events: int = 512
     batch_max_bytes: int = 1 << 20
+    # LWW clock-skew guard: an applied replication event whose timestamp
+    # is further than this beyond the local clock is CLAMPED to
+    # now + max_skew_ms (counted, per-peer attributed) — a peer with a
+    # misconfigured clock can delay convergence on a key by at most the
+    # skew bound instead of fencing it forever. 0 disables clamping.
+    max_skew_ms: int = 300_000
 
     def resolve_env(self) -> None:
         self.client_id = os.environ.get("CLIENT_ID", self.client_id)
@@ -205,6 +244,13 @@ class StorageConfig:
     device_min_keys: int = 4096
     # Write a final snapshot on clean shutdown (fast, verified restarts).
     snapshot_on_shutdown: bool = True
+    # Disk-free watermarks, checked on the store's ticker (statvfs on the
+    # data dir). Free bytes below soft: shed writes (retryable BUSY);
+    # below hard: read-only. 0 disables each; a live ENOSPC/EIO from the
+    # WAL always flips read-only regardless (reactive handling is not
+    # configurable). soft must be >= hard — it is the EARLIER warning.
+    disk_free_soft_bytes: int = 0
+    disk_free_hard_bytes: int = 0
 
 
 @dataclass
@@ -279,6 +325,7 @@ class Config:
     storage_path: str = "merklekv_data"
     engine: str = "mem"
     sync_interval_seconds: float = 60.0
+    server: ServerConfig = field(default_factory=ServerConfig)
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
@@ -310,6 +357,53 @@ class Config:
             cfg.sync_interval_seconds = float(raw["sync_interval_seconds"])
             if "interval_seconds" not in ae:
                 cfg.anti_entropy.interval_seconds = cfg.sync_interval_seconds
+        srv = raw.get("server", {})
+        for k in (
+            "max_connections",
+            "max_pipeline",
+            "memory_soft_bytes",
+            "memory_hard_bytes",
+        ):
+            if k in srv:
+                setattr(cfg.server, k, int(srv[k]))
+        if "recovery_ratio" in srv:
+            cfg.server.recovery_ratio = float(srv["recovery_ratio"])
+        if "watermark_interval_seconds" in srv:
+            cfg.server.watermark_interval_seconds = float(
+                srv["watermark_interval_seconds"]
+            )
+        if cfg.server.max_connections < 0:
+            raise ValueError(
+                "[server] max_connections must be >= 0 (0 = unlimited), "
+                f"got {cfg.server.max_connections}"
+            )
+        if cfg.server.max_pipeline < 0:
+            raise ValueError(
+                "[server] max_pipeline must be >= 0 (0 = unlimited), "
+                f"got {cfg.server.max_pipeline}"
+            )
+        if cfg.server.memory_soft_bytes < 0 or cfg.server.memory_hard_bytes < 0:
+            raise ValueError("[server] memory watermarks must be >= 0")
+        if (
+            cfg.server.memory_soft_bytes
+            and cfg.server.memory_hard_bytes
+            and cfg.server.memory_soft_bytes > cfg.server.memory_hard_bytes
+        ):
+            raise ValueError(
+                "[server] memory_soft_bytes must be <= memory_hard_bytes "
+                f"(soft sheds first), got {cfg.server.memory_soft_bytes} > "
+                f"{cfg.server.memory_hard_bytes}"
+            )
+        if not 0.0 < cfg.server.recovery_ratio < 1.0:
+            raise ValueError(
+                "[server] recovery_ratio must be in (0, 1), got "
+                f"{cfg.server.recovery_ratio}"
+            )
+        if cfg.server.watermark_interval_seconds <= 0:
+            raise ValueError(
+                "[server] watermark_interval_seconds must be > 0, got "
+                f"{cfg.server.watermark_interval_seconds}"
+            )
         rep = raw.get("replication", {})
         for k in ("mqtt_broker", "transport", "topic_prefix", "client_id",
                   "username", "password"):
@@ -325,6 +419,13 @@ class Config:
             cfg.replication.batch_max_events = int(rep["batch_max_events"])
         if "batch_max_bytes" in rep:
             cfg.replication.batch_max_bytes = int(rep["batch_max_bytes"])
+        if "max_skew_ms" in rep:
+            cfg.replication.max_skew_ms = int(rep["max_skew_ms"])
+        if cfg.replication.max_skew_ms < 0:
+            raise ValueError(
+                "[replication] max_skew_ms must be >= 0 (0 = no clamping), "
+                f"got {cfg.replication.max_skew_ms}"
+            )
         if cfg.replication.batch_max_bytes < 1024:
             raise ValueError(
                 "[replication] batch_max_bytes must be >= 1024, got "
@@ -402,9 +503,28 @@ class Config:
             "compact_trigger_bytes",
             "snapshots_retained",
             "device_min_keys",
+            "disk_free_soft_bytes",
+            "disk_free_hard_bytes",
         ):
             if k in st:
                 setattr(cfg.storage, k, int(st[k]))
+        if (
+            cfg.storage.disk_free_soft_bytes < 0
+            or cfg.storage.disk_free_hard_bytes < 0
+        ):
+            raise ValueError("[storage] disk-free watermarks must be >= 0")
+        if (
+            cfg.storage.disk_free_soft_bytes
+            and cfg.storage.disk_free_hard_bytes
+            and cfg.storage.disk_free_soft_bytes
+            < cfg.storage.disk_free_hard_bytes
+        ):
+            raise ValueError(
+                "[storage] disk_free_soft_bytes must be >= "
+                "disk_free_hard_bytes (soft is the earlier warning), got "
+                f"{cfg.storage.disk_free_soft_bytes} < "
+                f"{cfg.storage.disk_free_hard_bytes}"
+            )
         if "fsync_interval_seconds" in st:
             cfg.storage.fsync_interval_seconds = float(
                 st["fsync_interval_seconds"]

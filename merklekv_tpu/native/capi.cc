@@ -502,12 +502,46 @@ int mkv_server_wait_events(void* h, int timeout_ms) {
              : 0;
 }
 
-// Stats text exactly as the STATS command body (for the control plane).
+// Stats text exactly as the STATS command body (for the control plane):
+// the counter block plus the server-scope extension lines (event-queue
+// depth/drops, tombstone evictions, degradation level + shed counters).
 int mkv_server_stats(void* h, char** out, int* out_len) {
-  std::string s = static_cast<ServerHandle*>(h)->server->stats().format_stats();
+  std::string s = static_cast<ServerHandle*>(h)->server->stats_text();
   *out = dup_buffer(s);
   *out_len = int(s.size());
   return 1;
+}
+
+// Admission-control limits: max_connections (0 = unlimited; excess accepts
+// answered "ERROR BUSY connections" and closed) and max_pipeline (one
+// connection's in-flight pipelined-command budget; 0 = unlimited).
+void mkv_server_set_limits(void* h, long long max_connections,
+                           long long max_pipeline) {
+  static_cast<ServerHandle*>(h)->server->set_limits(
+      max_connections < 0 ? 0 : size_t(max_connections),
+      max_pipeline < 0 ? 0 : size_t(max_pipeline));
+}
+
+// Degradation ladder (overload protection): level 0=live 1=shedding
+// 2=read_only 3=draining; reason 0=none 1=memory 2=disk 3=draining
+// 4=admin. The control plane folds the watermark signals and pushes the
+// result here; the server enforces it on write verbs (BUSY/READONLY) and,
+// at draining, on new connections.
+void mkv_server_set_degradation(void* h, int level, int reason) {
+  if (level < 0) level = 0;
+  if (level > 3) level = 3;
+  static_cast<ServerHandle*>(h)->server->set_degradation(
+      mkv::Degradation(level), mkv::DegradeReason(reason));
+}
+
+int mkv_server_degradation(void* h) {
+  return static_cast<ServerHandle*>(h)->server->degradation();
+}
+
+// Change-event queue depth (staged-but-undrained events) — the
+// replication/WAL feed's backlog gauge.
+long long mkv_server_events_depth(void* h) {
+  return (long long)static_cast<ServerHandle*>(h)->server->events().size();
 }
 
 }  // extern "C"

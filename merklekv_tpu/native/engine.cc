@@ -89,7 +89,14 @@ bool MemEngine::set_with_ts(const std::string& key, const std::string& value,
                             uint64_t ts) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  s.map[key] = Entry{value, ts};
+  auto it = s.map.find(key);
+  if (it != s.map.end()) {
+    acct((long long)value.size() - (long long)it->second.value.size());
+    it->second = Entry{value, ts};
+  } else {
+    acct((long long)(key.size() + value.size()));
+    s.map.emplace(key, Entry{value, ts});
+  }
   // A present value supersedes any deletion record: without this a key
   // would be advertised live AND tombstoned to peers at once.
   s.tombs.erase(key);
@@ -176,7 +183,12 @@ bool MemEngine::del_with_ts_report(const std::string& key, uint64_t ts,
                                    bool* advanced) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  bool existed = s.map.erase(key) > 0;
+  auto it = s.map.find(key);
+  bool existed = it != s.map.end();
+  if (existed) {
+    acct(-(long long)(key.size() + it->second.value.size()));
+    s.map.erase(it);
+  }
   bool tomb_advanced = note_tomb(s, key, ts);
   *advanced = existed || tomb_advanced;
   if (*advanced) bump_version();
@@ -186,8 +198,13 @@ bool MemEngine::del_with_ts_report(const std::string& key, uint64_t ts,
 bool MemEngine::del_quiet(const std::string& key) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  bool existed = s.map.erase(key) > 0;
-  if (existed) bump_version();
+  auto it = s.map.find(key);
+  bool existed = it != s.map.end();
+  if (existed) {
+    acct(-(long long)(key.size() + it->second.value.size()));
+    s.map.erase(it);
+    bump_version();
+  }
   return existed;
 }
 
@@ -229,7 +246,13 @@ bool MemEngine::set_if_newer_locked(Shard& s, const std::string& key,
     // deletion-stability — it would only pin the stale value.
     return false;
   }
-  s.map[key] = Entry{value, ts};
+  if (it != s.map.end()) {
+    acct((long long)value.size() - (long long)it->second.value.size());
+    it->second = Entry{value, ts};
+  } else {
+    acct((long long)(key.size() + value.size()));
+    s.map.emplace(key, Entry{value, ts});
+  }
   if (tt != s.tombs.end()) s.tombs.erase(tt);
   bump_version();
   return true;
@@ -246,6 +269,7 @@ bool MemEngine::del_if_newer_locked(Shard& s, const std::string& key,
   auto it = s.map.find(key);
   if (it != s.map.end()) {
     if (ts <= it->second.ts) return false;  // tie: value wins
+    acct(-(long long)(key.size() + it->second.value.size()));
     s.map.erase(it);
     note_tomb(s, key, ts);
     bump_version();
@@ -424,12 +448,12 @@ size_t MemEngine::dbsize() {
 }
 
 size_t MemEngine::memory_usage() {
-  size_t n = 0;
-  for (Shard& s : shards_) {
-    std::shared_lock lk(s.mu);
-    for (const auto& [k, e] : s.map) n += k.size() + e.value.size();
-  }
-  return n;
+  // O(1): the incremental byte counter maintained at every map mutation
+  // under the shard locks. Approximate by design (string capacity, map
+  // overhead, and tombstones are not counted) — it is the watermark
+  // signal for the overload monitor, not an allocator report.
+  long long n = approx_bytes_.load(std::memory_order_relaxed);
+  return n > 0 ? size_t(n) : 0;
 }
 
 Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
@@ -442,7 +466,14 @@ Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
   }
   // Wrapping add (reference release-mode semantics).
   int64_t next = int64_t(uint64_t(cur) + uint64_t(delta));
-  s.map[key] = Entry{std::to_string(next), now_ns()};
+  std::string text = std::to_string(next);
+  if (it != s.map.end()) {
+    acct((long long)text.size() - (long long)it->second.value.size());
+    it->second = Entry{std::move(text), now_ns()};
+  } else {
+    acct((long long)(key.size() + text.size()));
+    s.map.emplace(key, Entry{std::move(text), now_ns()});
+  }
   s.tombs.erase(key);  // live entry supersedes any deletion record
   bump_version();
   return Result<int64_t>::Ok(next);
@@ -469,7 +500,13 @@ Result<std::string> MemEngine::splice(const std::string& key,
   } else {
     next = value + it->second.value;
   }
-  s.map[key] = Entry{next, now_ns()};
+  if (it != s.map.end()) {
+    acct((long long)next.size() - (long long)it->second.value.size());
+    it->second = Entry{next, now_ns()};
+  } else {
+    acct((long long)(key.size() + next.size()));
+    s.map.emplace(key, Entry{next, now_ns()});
+  }
   s.tombs.erase(key);  // live entry supersedes any deletion record
   bump_version();
   return Result<std::string>::Ok(next);
@@ -488,6 +525,9 @@ Result<std::string> MemEngine::prepend(const std::string& key,
 bool MemEngine::truncate() {
   for (Shard& s : shards_) {
     std::unique_lock lk(s.mu);
+    for (const auto& [k, e] : s.map) {
+      acct(-(long long)(k.size() + e.value.size()));
+    }
     s.map.clear();
     // TRUNCATE is a local admin wipe, not a per-key deletion: it stays
     // local (never replicated) and drops deletion history with the data.

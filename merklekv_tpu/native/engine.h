@@ -258,6 +258,13 @@ class MemEngine : public Engine {
   void bump_version() {
     version_.fetch_add(1, std::memory_order_acq_rel);
   }
+  // Incremental resident-bytes accounting (live keys + values), adjusted
+  // at every map insert/replace/erase under the shard lock. Keeps
+  // memory_usage() O(1) so the overload monitor can poll the memory
+  // watermark every few hundred ms without walking 10M entries.
+  void acct(long long delta) {
+    approx_bytes_.fetch_add(delta, std::memory_order_relaxed);
+  }
   Result<int64_t> add(const std::string& key, int64_t delta);
   Result<std::string> splice(const std::string& key, const std::string& value,
                              bool append);
@@ -268,6 +275,7 @@ class MemEngine : public Engine {
   size_t max_tombs_;
   std::atomic<uint64_t> tomb_evictions_{0};
   std::atomic<uint64_t> version_{1};
+  std::atomic<long long> approx_bytes_{0};
 };
 
 // Durable engine: MemEngine semantics + append-only operation log

@@ -54,6 +54,38 @@ bool send_all(int fd, const std::string& data) {
   return true;
 }
 
+const char* degrade_reason_text(int reason) {
+  switch (DegradeReason(reason)) {
+    case DegradeReason::kMemory: return "memory";
+    case DegradeReason::kDisk: return "disk";
+    case DegradeReason::kDraining: return "draining";
+    case DegradeReason::kAdmin: return "admin";
+    default: return "overload";
+  }
+}
+
+// Verbs refused while the node sheds or runs read-only. Everything else —
+// reads, PING, STATS/INFO/METRICS, and the whole cluster-management plane
+// (SYNC/REPLICATE/SNAPMETA/...) — keeps serving: anti-entropy is the
+// mechanism that repairs what shedding drops, so it must never be behind
+// the gate it exists to clean up after.
+bool is_write_verb(Verb v) {
+  switch (v) {
+    case Verb::Set:
+    case Verb::Delete:
+    case Verb::Increment:
+    case Verb::Decrement:
+    case Verb::Append:
+    case Verb::Prepend:
+    case Verb::MultiSet:
+    case Verb::Truncate:
+    case Verb::Flushdb:
+      return true;
+    default:
+      return false;
+  }
+}
+
 }  // namespace
 
 Server::Server(Engine* engine, ServerOptions opts)
@@ -165,6 +197,28 @@ void Server::accept_loop() {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 
+    // Admission control: past max_connections (or while draining) the
+    // excess accept is answered BUSY and closed RIGHT HERE — no handler
+    // thread, no client registration, no request state. The answer goes
+    // out within one RTT of the connect (the reply rides the accept
+    // loop), and established connections never see the flood: their
+    // handler threads already exist.
+    const size_t maxc = max_connections_.load(std::memory_order_acquire);
+    const bool draining =
+        degradation_.load(std::memory_order_acquire) >=
+        int(Degradation::kDraining);
+    if (draining ||
+        (maxc > 0 &&
+         stats_.active_connections.load(std::memory_order_relaxed) >= maxc)) {
+      stats_.busy_rejected_connections.fetch_add(1,
+                                                 std::memory_order_relaxed);
+      send_all(fd, draining
+                       ? "ERROR BUSY draining\r\n"
+                       : "ERROR BUSY connections retry\r\n");
+      ::close(fd);
+      continue;
+    }
+
     char ip[INET_ADDRSTRLEN] = "?";
     ::inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
     auto meta = std::make_shared<ClientMeta>();
@@ -209,12 +263,21 @@ void Server::accept_loop() {
 bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
   std::string buf;
   char chunk[65536];
+  // In-flight budget: commands buffered-but-unprocessed on this
+  // connection. Incremented per newline received, decremented per line
+  // dispatched; since dispatch is synchronous, in steady state this is
+  // the line count of ONE recv() burst — the budget caps how much
+  // parse/response work a single read can queue, not a cumulative
+  // backlog (none can accumulate: every response is written before the
+  // next recv). Exceeding it answers BUSY and closes.
+  size_t pending = 0;
   for (;;) {
     // Extract complete lines already buffered.
     size_t nl;
     while ((nl = buf.find('\n')) != std::string::npos) {
       std::string line = buf.substr(0, nl + 1);
       buf.erase(0, nl + 1);
+      if (pending > 0) --pending;
       if (line.size() > opts_.max_line) {
         send_all(fd, "ERROR line too long\r\n");
         return false;
@@ -274,8 +337,45 @@ bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
     }
     ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
     if (r <= 0) return false;
+    for (ssize_t i = 0; i < r; ++i) {
+      if (chunk[i] == '\n') ++pending;
+    }
+    const size_t maxp = max_pipeline_.load(std::memory_order_acquire);
+    if (maxp > 0 && pending > maxp) {
+      stats_.pipeline_rejected.fetch_add(1, std::memory_order_relaxed);
+      send_all(fd, "ERROR BUSY pipeline retry\r\n");
+      return false;
+    }
     buf.append(chunk, size_t(r));
   }
+}
+
+std::string Server::stats_text() {
+  // One body for the STATS verb AND the C-API bridge (mkv_server_stats ->
+  // /metrics): the reference-parity counter block, then the extension
+  // lines — engine tombstone evictions, event-queue depth/drops (the
+  // replication feed's backlog), and the overload plane (degradation
+  // level + shed counters). All integer-valued `name:value` text, so the
+  // exporter bridges every line without special cases.
+  std::string out = stats_.format_stats();
+  auto add = [&](const char* name, unsigned long long v) {
+    out += name;
+    out += ":";
+    out += std::to_string(v);
+    out += "\r\n";
+  };
+  auto ld = [](const std::atomic<uint64_t>& a) {
+    return (unsigned long long)a.load(std::memory_order_relaxed);
+  };
+  add("tombstone_evictions", engine_->tomb_evictions());
+  add("events_queue_depth", events_.size());
+  add("events_dropped", events_.dropped());
+  add("degradation", degradation_.load(std::memory_order_acquire));
+  add("busy_rejected_connections", ld(stats_.busy_rejected_connections));
+  add("pipeline_rejected", ld(stats_.pipeline_rejected));
+  add("shed_commands", ld(stats_.shed_commands));
+  add("readonly_commands", ld(stats_.readonly_commands));
+  return out;
 }
 
 std::mutex& Server::write_stripe(const std::string& key) {
@@ -290,6 +390,22 @@ void Server::stage_event(ChangeOp op, const std::string& key,
 }
 
 std::string Server::dispatch(const Command& cmd, bool* close_conn) {
+  // Degradation ladder: shedding answers writes with a RETRYABLE BUSY
+  // (memory/disk pressure is transient — clients back off and retry);
+  // read_only/draining answer READONLY (not retryable until the node
+  // recovers). Reads and the management/anti-entropy plane stay open —
+  // anti-entropy is what repairs whatever the hot path sheds.
+  const int deg = degradation_.load(std::memory_order_acquire);
+  if (deg >= int(Degradation::kShedding) && is_write_verb(cmd.verb)) {
+    const char* why =
+        degrade_reason_text(degrade_reason_.load(std::memory_order_acquire));
+    if (deg == int(Degradation::kShedding)) {
+      stats_.shed_commands.fetch_add(1, std::memory_order_relaxed);
+      return std::string("ERROR BUSY ") + why + " retry\r\n";
+    }
+    stats_.readonly_commands.fetch_add(1, std::memory_order_relaxed);
+    return std::string("ERROR READONLY ") + why + "\r\n";
+  }
   if (!serving_.load(std::memory_order_acquire)) {
     // Bootstrap gate: no read serves before the shipped snapshot's stamped
     // root VERIFIES (cluster/bootstrap.py flips the gate). Blocking the
@@ -732,10 +848,7 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       return "OK\r\n";
     }
     case Verb::Stats:
-      // Engine-level line after the reference counter set: deletion records
-      // silently dropped by the bounded tombstone map (engine.h).
-      return "STATS\r\n" + stats_.format_stats() + "tombstone_evictions:" +
-             std::to_string(engine_->tomb_evictions()) + "\r\nEND\r\n";
+      return "STATS\r\n" + stats_text() + "END\r\n";
     case Verb::Info: {
       std::string out = "INFO\r\n";
       out += "version:" + opts_.version + "\r\n";

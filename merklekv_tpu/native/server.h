@@ -52,6 +52,35 @@ struct ServerOptions {
   size_t max_line = 1024 * 1024;
 };
 
+// Node-wide degradation ladder (overload protection): each rung sheds a
+// little more load so the node stays alive under resource pressure
+// instead of crashing. The control plane (cluster/overload.py) folds the
+// watermark signals and pushes the level here; the server enforces it on
+// the request path.
+//   live      — everything serves.
+//   shedding  — write verbs answer "ERROR BUSY <why> retry" (retryable;
+//               reads and the management plane stay open).
+//   read_only — write verbs answer "ERROR READONLY <why>" (not
+//               retryable until the node recovers).
+//   draining  — read_only + new connections are refused BUSY (node is
+//               shutting down; established connections finish).
+enum class Degradation : int {
+  kLive = 0,
+  kShedding = 1,
+  kReadOnly = 2,
+  kDraining = 3,
+};
+
+// Why the node degraded (rides in the BUSY/READONLY error text so a
+// client-side retry policy can tell transient shed from shutdown).
+enum class DegradeReason : int {
+  kNone = 0,
+  kMemory = 1,
+  kDisk = 2,
+  kDraining = 3,
+  kAdmin = 4,
+};
+
 class Server {
  public:
   Server(Engine* engine, ServerOptions opts);
@@ -98,6 +127,36 @@ class Server {
   }
   bool serving() const { return serving_.load(std::memory_order_acquire); }
 
+  // Admission-control limits (overload protection). max_connections 0 =
+  // unlimited: past it, accepted sockets are answered "ERROR BUSY
+  // connections" and closed without spawning a handler thread — a
+  // connection flood can exhaust neither threads nor request state.
+  // max_pipeline bounds one connection's commands BUFFERED-BUT-
+  // UNPROCESSED at once (dispatch is synchronous, so this is the only
+  // backlog that can exist): exceeding it answers BUSY and closes.
+  // Coarse by design — one recv() of tiny commands can carry thousands
+  // of lines, so set it ABOVE the deepest pipeline well-behaved clients
+  // use (or leave 0 = unlimited; the 1 MiB line buffer already bounds
+  // bytes).
+  void set_limits(size_t max_connections, size_t max_pipeline) {
+    max_connections_.store(max_connections, std::memory_order_release);
+    max_pipeline_.store(max_pipeline, std::memory_order_release);
+  }
+  // Degradation ladder: the control plane pushes the folded watermark
+  // level; dispatch() enforces it on write verbs, accept on connections.
+  void set_degradation(Degradation level, DegradeReason reason) {
+    degrade_reason_.store(int(reason), std::memory_order_release);
+    degradation_.store(int(level), std::memory_order_release);
+  }
+  int degradation() const {
+    return degradation_.load(std::memory_order_acquire);
+  }
+  // STATS body shared by the wire verb and the C API bridge: the counter
+  // block plus the server-scope extension lines (event-queue depth/drops,
+  // engine tombstone evictions, the degradation level and its shed
+  // counters) so /metrics sees the overload plane without a new channel.
+  std::string stats_text();
+
  private:
   void accept_loop();
   // Returns true if the connection requested server shutdown.
@@ -117,6 +176,13 @@ class Server {
   std::atomic<bool> events_enabled_{false};
   std::atomic<bool> latency_enabled_{true};
   std::atomic<bool> serving_{true};
+  std::atomic<size_t> max_connections_{0};  // 0 = unlimited
+  // 0 = unlimited, like every watermark: deep pipelining is a legitimate
+  // throughput pattern (the pipelined bench sends thousands of commands
+  // per write), so the budget is strictly opt-in per deployment.
+  std::atomic<size_t> max_pipeline_{0};
+  std::atomic<int> degradation_{0};     // Degradation enum value
+  std::atomic<int> degrade_reason_{0};  // DegradeReason enum value
   static constexpr size_t kWriteStripes = 64;
   std::mutex write_stripes_[kWriteStripes];
   std::atomic<int> listen_fd_{-1};

@@ -69,6 +69,22 @@ struct ServerStats {
   std::atomic<uint64_t> replicate_commands{0};
   std::atomic<uint64_t> management_commands{0};
 
+  // Overload-protection counters (extension lines; emitted by
+  // Server::stats_text, not format_stats, so the reference-parity block
+  // above stays byte-compatible):
+  //   busy_rejected_connections — accepts refused past max_connections
+  //                               (answered "ERROR BUSY connections").
+  //   pipeline_rejected         — connections closed for exceeding their
+  //                               in-flight pipeline budget.
+  //   shed_commands             — write verbs answered "ERROR BUSY"
+  //                               while the node was shedding.
+  //   readonly_commands         — write verbs answered "ERROR READONLY"
+  //                               while the node was read_only/draining.
+  std::atomic<uint64_t> busy_rejected_connections{0};
+  std::atomic<uint64_t> pipeline_rejected{0};
+  std::atomic<uint64_t> shed_commands{0};
+  std::atomic<uint64_t> readonly_commands{0};
+
   LatencyHisto latency;
 
   uint64_t uptime_seconds() const {

@@ -168,6 +168,15 @@ def _load() -> ctypes.CDLL:
     lib.mkv_server_enable_latency.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mkv_server_set_serving.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mkv_server_serving.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_set_limits.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+    ]
+    lib.mkv_server_set_degradation.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.mkv_server_degradation.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_events_depth.restype = ctypes.c_longlong
+    lib.mkv_server_events_depth.argtypes = [ctypes.c_void_p]
     lib.mkv_server_drain_events.argtypes = [
         ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
     ]
@@ -592,6 +601,42 @@ class NativeServer:
         if not self._h:
             return False
         return bool(self._lib.mkv_server_serving(self._h))
+
+    def set_limits(
+        self, max_connections: int = 0, max_pipeline: int = 0
+    ) -> None:
+        """Admission-control limits: past ``max_connections`` (0 =
+        unlimited) excess accepts are answered ``ERROR BUSY connections``
+        and closed before a handler thread exists; ``max_pipeline`` bounds
+        one connection's unanswered pipelined commands (0 = unlimited)."""
+        if self._h:
+            self._lib.mkv_server_set_limits(
+                self._h, max_connections, max_pipeline
+            )
+
+    def set_degradation(self, level: int, reason: int = 0) -> None:
+        """Push the node's degradation-ladder level (0=live 1=shedding
+        2=read_only 3=draining; reason 0=none 1=memory 2=disk 3=draining
+        4=admin). The native server enforces it: shedding answers write
+        verbs ``ERROR BUSY <why> retry``, read_only/draining answer
+        ``ERROR READONLY <why>``, draining also refuses new connections.
+        Reads and the management/anti-entropy plane stay open."""
+        if self._h:
+            self._lib.mkv_server_set_degradation(self._h, level, reason)
+
+    @property
+    def degradation(self) -> int:
+        """Current degradation-ladder level (0=live .. 3=draining)."""
+        if not self._h:
+            return 0
+        return int(self._lib.mkv_server_degradation(self._h))
+
+    def events_depth(self) -> int:
+        """Staged-but-undrained change events (the replication/WAL feed's
+        backlog; also on STATS as ``events_queue_depth``)."""
+        if not self._h:
+            return 0
+        return int(self._lib.mkv_server_events_depth(self._h))
 
     def drain_events(self, max_events: int = 0) -> list[ChangeEventRaw]:
         out = ctypes.c_void_p()

@@ -55,6 +55,12 @@ CATALOG: dict[str, tuple[str, str]] = {
         "counter", "Cycles degraded to full transfer (no LEAFHASHES)."),
     "anti_entropy.leafhash_aborts": (
         "counter", "LEAFHASHES fetches aborted by transport death."),
+    "anti_entropy.overload_skips": (
+        "counter", "Anti-entropy cycles deferred while the node was above "
+        "a resource watermark."),
+    "anti_entropy.skew_clamped": (
+        "counter", "Adopted peer timestamps clamped by the LWW clock-skew "
+        "guard at the repair-install boundary."),
     "sync.bytes_sent": (
         "counter", "Anti-entropy wire bytes sent (client-measured)."),
     "sync.bytes_received": (
@@ -80,6 +86,10 @@ CATALOG: dict[str, tuple[str, str]] = {
         "counter", "Held events replayed at bootstrap gate-open."),
     "replicator.buffer_dropped": (
         "counter", "Held events dropped past the RAM cap (repaired later)."),
+    "replicator.skew_clamped": (
+        "counter", "Applied-event timestamps clamped by the LWW clock-skew "
+        "guard (per-peer attribution rides as "
+        "replicator.skew_clamped.<src>)."),
     "replicator.batch_size": (
         "histogram", "Events per published replication frame (size "
         "histogram: le bounds are event counts)."),
@@ -104,6 +114,18 @@ CATALOG: dict[str, tuple[str, str]] = {
     "storage.recovery_root_mismatch": (
         "counter", "Snapshots rejected by root verification."),
     "storage.wal_fsync": ("histogram", "WAL fsync latency."),
+    "storage.full_errors": (
+        "counter", "WAL/snapshot writes failed with ENOSPC/EIO (node "
+        "degrades read-only; drain threads survive)."),
+    "storage.full_recoveries": (
+        "counter", "Full-disk conditions cleared by the recovery probe "
+        "(a re-anchor snapshot closes the journal gap)."),
+    "storage.records_dropped": (
+        "counter", "Records not journaled during a full-disk window "
+        "(live in the engine; re-anchored on recovery)."),
+    "storage.compactions_deferred": (
+        "counter", "Snapshot compactions deferred under memory pressure "
+        "(trigger stays pending)."),
     # -- device plane ------------------------------------------------------
     "device.scatter_keys": (
         "counter", "Keys updated via incremental device scatter."),
@@ -139,11 +161,38 @@ CATALOG: dict[str, tuple[str, str]] = {
         "counter", "SNAPCHUNK frames served as a donor."),
     "bootstrap.donor_bytes": (
         "counter", "Raw snapshot bytes served as a donor."),
+    # -- overload protection ------------------------------------------------
+    "node.degradation_changes": (
+        "counter", "Degradation-ladder transitions (live/shedding/"
+        "read_only/draining) pushed by the overload monitor."),
+    "node.overload_monitor_errors": (
+        "counter", "Overload-monitor poll ticks that raised internally."),
     # -- exporter-built families ------------------------------------------
     "span_duration": (
         "histogram", "Control-plane span latency (per span name)."),
     "native_cmd_latency": (
         "histogram", "Native server per-command dispatch latency."),
+    # -- native STATS bridge (server scope, prefixed mkv_native_*) ---------
+    "native.events_queue_depth": (
+        "gauge", "Staged-but-undrained change events in the native event "
+        "queue (the replication/WAL feed's backlog)."),
+    "native.events_dropped": (
+        "counter", "Change events dropped by the bounded native event "
+        "queue at capacity (anti-entropy repairs the residue)."),
+    "native.degradation": (
+        "gauge", "Degradation ladder as enforced natively (0=live "
+        "1=shedding 2=read_only 3=draining)."),
+    "native.busy_rejected_connections": (
+        "counter", "Accepts refused past [server] max_connections "
+        "(answered ERROR BUSY and closed)."),
+    "native.pipeline_rejected": (
+        "counter", "Connections closed for exceeding their in-flight "
+        "pipeline budget."),
+    "native.shed_commands": (
+        "counter", "Write commands answered ERROR BUSY while shedding."),
+    "native.readonly_commands": (
+        "counter", "Write commands answered ERROR READONLY while "
+        "read-only/draining."),
 }
 
 

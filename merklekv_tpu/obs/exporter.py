@@ -227,6 +227,8 @@ def render_prometheus(
             san = _san(name)
             if name.endswith(("_commands", "_connections")) or name in (
                 "tombstone_evictions",
+                "events_dropped",
+                "pipeline_rejected",
             ):
                 out.append(
                     f"# HELP mkv_native_{san} "

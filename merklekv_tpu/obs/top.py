@@ -4,7 +4,8 @@ Polls STATS / INFO / METRICS / PEERS across a node list over the normal
 wire protocol (no exporter needed), computes per-interval rates from
 successive counter samples, and renders one table per refresh:
 
-    NODE              KEYS     OPS/S   SET/S   GET/S  P50_US  SYNC_KB/S  CONN  PEERS_UP  STATUS
+    NODE  KEYS  OPS/S  SET/S  GET/S  P50_US  SYNC_KB/S  CONN  PEERS_UP
+    LAG_EV  LAG_MS  READY  STATE  SHED/S  STATUS
 
 ``--once`` prints a single frame (two quick samples for rates) and exits —
 scriptable and testable; without it the screen refreshes every
@@ -48,6 +49,12 @@ class NodeSample:
     lag_events: int = 0
     lag_ms: float = 0.0
     readiness: str = "-"
+    # Overload plane (METRICS node.degradation / node.shed_total lines):
+    # the degradation rung and the cumulative shed count (BUSY-answered
+    # writes + refused connections + pipeline closes) — rendered as the
+    # STATE and SHED/s columns ("-" on nodes predating the ladder).
+    state: str = "-"
+    shed_total: int = 0
 
 
 def _p50_from_stats(stats: dict[str, str]) -> Optional[float]:
@@ -109,6 +116,14 @@ def sample_node(node: str, timeout: float = 2.0) -> NodeSample:
 
     names = {str(code): name for name, code in READINESS_CODES.items()}
     s.readiness = names.get(metrics.get("readiness_code", ""), "-")
+    from merklekv_tpu.cluster.overload import LEVEL_NAMES
+
+    level_names = {str(code): name for code, name in LEVEL_NAMES.items()}
+    s.state = level_names.get(metrics.get("node.degradation", ""), "-")
+    try:
+        s.shed_total = int(metrics.get("node.shed_total", 0) or 0)
+    except ValueError:
+        pass
     for name, value in metrics.items():
         try:
             if name.startswith("replication.lag_events."):
@@ -130,7 +145,8 @@ def render_table(
     header = (
         f"{'NODE':<22} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} {'GET/S':>8} "
         f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONN':>5} {'PEERS_UP':>9} "
-        f"{'LAG_EV':>7} {'LAG_MS':>8} {'READY':>8} STATUS"
+        f"{'LAG_EV':>7} {'LAG_MS':>8} {'READY':>8} {'STATE':>9} "
+        f"{'SHED/S':>7} STATUS"
     )
     lines = [header, "-" * len(header)]
     for node in cur:
@@ -139,7 +155,7 @@ def render_table(
         if not c.ok:
             lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
                          f"{'-':>7} {'-':>10} {'-':>5} {'-':>9} "
-                         f"{'-':>7} {'-':>8} {'-':>8} "
+                         f"{'-':>7} {'-':>8} {'-':>8} {'-':>9} {'-':>7} "
                          f"DOWN ({c.error})")
             continue
         dt = (c.unix - p.unix) if (p is not None and p.ok) else 0.0
@@ -149,6 +165,7 @@ def render_table(
         sync_kb = (
             _rate(c.sync_bytes, p.sync_bytes, dt) / 1024.0 if dt else 0.0
         )
+        shed = _rate(c.shed_total, p.shed_total, dt) if dt else 0.0
         p50 = f"{c.latency_p50_us:.0f}" if c.latency_p50_us else "-"
         peers = (
             f"{c.peers_up}/{c.peers_total}" if c.peers_total else "-"
@@ -157,7 +174,7 @@ def render_table(
             f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
             f"{p50:>7} {sync_kb:>10.1f} {c.active_connections:>5} "
             f"{peers:>9} {c.lag_events:>7} {c.lag_ms:>8.1f} "
-            f"{c.readiness:>8} UP"
+            f"{c.readiness:>8} {c.state:>9} {shed:>7.1f} UP"
         )
     return "\n".join(lines)
 

@@ -37,13 +37,18 @@ from merklekv_tpu.storage.snapshot import (
     RootMismatchError,
     SnapshotCorruptError,
 )
-from merklekv_tpu.storage.wal import WalRecord, WalWriter
+from merklekv_tpu.storage.wal import (
+    StorageFullError,
+    WalRecord,
+    WalWriter,
+)
 from merklekv_tpu.utils.tracing import get_metrics, span
 
 __all__ = [
     "DurableStore",
     "RecoveryError",
     "RecoveryReport",
+    "StorageFullError",
     "StorageLockedError",
     "node_data_dir",
 ]
@@ -156,6 +161,31 @@ class DurableStore:
         # ticker tick.
         self._snapshot_requested = False
         self.last_recovery: Optional[RecoveryReport] = None
+        # Resource-fault state (overload protection). ``_full`` latches
+        # when a WAL append/fsync (or a snapshot write) dies with
+        # ENOSPC/EIO: the error is swallowed (the drain thread must
+        # SURVIVE a full disk), the dropped records are counted, and the
+        # overload monitor reads the verdict through overload_level() to
+        # flip the node read-only. The ticker probes for recovery — a
+        # small write+fsync through the same io seam — and on success
+        # requests a re-anchor snapshot: engine state is authoritative,
+        # and the fresh snapshot closes the journal gap the full-disk
+        # window opened.
+        self._full = False
+        self._full_reason = ""
+        self._disk_level = 0  # watermark hysteresis state (overload.LIVE)
+        self.disk_free_bytes: Optional[int] = None
+        self._defer_compaction = None  # Callable[[], bool] (memory gate)
+        # Probe-recovery backoff: a 4 KiB probe can succeed on a disk that
+        # still cannot fit the multi-MB re-anchor snapshot — without
+        # backoff the store would flap latch->probe->snapshot-ENOSPC->
+        # re-latch every tick, burning megabytes of doomed snapshot I/O
+        # per second on an already-sick disk. Re-latching shortly after a
+        # recovery doubles the wait before the next probe (2s..60s); a
+        # snapshot that actually completes resets it.
+        self._probe_backoff_s = 0.0
+        self._next_probe_m = 0.0
+        self._recovered_at_m = 0.0
 
     # -- locking --------------------------------------------------------------
     @staticmethod
@@ -359,6 +389,7 @@ class DurableStore:
         cfg = self._cfg
         tick = min(max(cfg.fsync_interval_seconds, 0.01), 0.5)
         last_fsync = time.monotonic()
+        last_disk = 0.0
         while not self._stop_evt.wait(tick):
             now = time.monotonic()
             if (
@@ -367,10 +398,29 @@ class DurableStore:
             ):
                 self.fsync()
                 last_fsync = now
+            if now - last_disk >= min(1.0, max(tick, 0.05)):
+                # Disk watermark check + full-disk recovery probe, at most
+                # ~1/s: one statvfs, plus (only while latched full) a tiny
+                # probe write through the WAL io seam.
+                last_disk = now
+                self._check_disk()
             if self._snapshot_requested or (
                 cfg.compact_trigger_bytes > 0
                 and self._bytes_since_snapshot >= cfg.compact_trigger_bytes
             ):
+                defer = self._defer_compaction
+                if defer is not None:
+                    try:
+                        if defer():
+                            # Memory pressure: a snapshot materializes the
+                            # whole keyspace host-side — exactly the
+                            # allocation a pressured node must not make.
+                            # The trigger stays pending; disk pressure
+                            # never defers (compaction FREES segments).
+                            get_metrics().inc("storage.compactions_deferred")
+                            continue
+                    except Exception:
+                        pass  # a broken gate must not stop compaction
                 try:
                     self.compact()
                     # Only a SUCCESSFUL snapshot satisfies the request — a
@@ -378,8 +428,156 @@ class DurableStore:
                     # the re-anchor pending or corruption recovery's
                     # replay barrier never moves.
                     self._snapshot_requested = False
+                except StorageFullError as e:
+                    self._note_full(e)
+                    get_metrics().inc("storage.compaction_errors")
+                except OSError as e:
+                    import errno as _errno
+
+                    if e.errno in (
+                        _errno.ENOSPC, _errno.EIO,
+                        getattr(_errno, "EDQUOT", -1),
+                    ):
+                        self._note_full(e)
+                    get_metrics().inc("storage.compaction_errors")
                 except Exception:
                     get_metrics().inc("storage.compaction_errors")
+
+    # -- resource faults (overload protection) ---------------------------------
+    # Level codes match cluster/overload.py (LIVE/SHEDDING/READ_ONLY);
+    # kept as literals here so the storage layer stays import-free of the
+    # cluster plane.
+    _LIVE, _SHEDDING, _READ_ONLY = 0, 1, 2
+    # Watermark release factor: free bytes must exceed watermark * this to
+    # step back down (hysteresis — a disk hovering at the boundary must
+    # not flap the node between rungs).
+    _DISK_RELEASE = 1.25
+
+    def _note_full(self, cause: Exception) -> None:
+        """A WAL/snapshot write hit ENOSPC/EIO: latch the full condition
+        (the overload monitor flips the node read-only from it), loudly,
+        exactly once per episode."""
+        get_metrics().inc("storage.full_errors")
+        if not self._full:
+            self._full = True
+            self._full_reason = str(cause)
+            now = time.monotonic()
+            if now - self._recovered_at_m < 10.0:
+                # Re-latched right after a probe recovery: the probe lied
+                # (room for 4 KiB, not for the re-anchor). Back off before
+                # probing again instead of flapping every tick.
+                self._probe_backoff_s = min(
+                    60.0, max(2.0, self._probe_backoff_s * 2)
+                )
+                self._next_probe_m = now + self._probe_backoff_s
+            import sys
+
+            print(
+                f"storage: disk full/failing, node degrading to read-only "
+                f"({cause})",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    def _check_disk(self) -> None:
+        """Ticker-side disk evaluation: refresh the free-bytes watermark
+        signal and, while latched full, probe for recovery."""
+        try:
+            st = os.statvfs(self._dir)
+            self.disk_free_bytes = st.f_bavail * st.f_frsize
+        except OSError:
+            self.disk_free_bytes = None
+        soft = getattr(self._cfg, "disk_free_soft_bytes", 0)
+        hard = getattr(self._cfg, "disk_free_hard_bytes", 0)
+        free = self.disk_free_bytes
+        lvl = self._disk_level
+        if free is not None and (soft or hard):
+            if hard and free < hard:
+                lvl = self._READ_ONLY
+            elif lvl == self._READ_ONLY and (
+                not hard or free > hard * self._DISK_RELEASE
+            ):
+                lvl = self._SHEDDING
+            if lvl == self._SHEDDING and (
+                not soft or free > soft * self._DISK_RELEASE
+            ):
+                lvl = self._LIVE
+            if lvl == self._LIVE and soft and free < soft:
+                lvl = self._SHEDDING
+        else:
+            lvl = self._LIVE
+        self._disk_level = lvl
+        if self._full:
+            self._try_recover_full()
+
+    def _try_recover_full(self) -> None:
+        """Probe the latched full condition: a small write+fsync+unlink
+        through the SAME io seam the WAL uses (so both a real ENOSPC and
+        the chaos suite's injected one gate recovery identically). On
+        success the node returns to live and a re-anchor snapshot is
+        requested — the records dropped during the full window exist only
+        in the engine, and the fresh snapshot is what restores their
+        durability."""
+        now = time.monotonic()
+        if now < self._next_probe_m:
+            return  # backing off after a flapped recovery
+        hard = getattr(self._cfg, "disk_free_hard_bytes", 0)
+        if (
+            hard
+            and self.disk_free_bytes is not None
+            and self.disk_free_bytes < hard * self._DISK_RELEASE
+        ):
+            return  # space still below the release watermark: keep waiting
+        from merklekv_tpu.storage import wal as walmod_seam
+
+        probe = os.path.join(self._dir, ".diskprobe")
+        try:
+            fd = os.open(probe, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                walmod_seam.io_write(fd, b"\0" * 4096)
+                walmod_seam.io_fsync(fd)
+            finally:
+                os.close(fd)
+                try:
+                    os.unlink(probe)
+                except OSError:
+                    pass
+        except OSError:
+            return  # still full; probe again next tick
+        self._full = False
+        self._full_reason = ""
+        self._recovered_at_m = time.monotonic()
+        self._snapshot_requested = True  # re-anchor: close the journal gap
+        get_metrics().inc("storage.full_recoveries")
+        import sys
+
+        print(
+            "storage: disk writable again, re-anchoring snapshot and "
+            "returning to live",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def overload_level(self) -> tuple[int, str]:
+        """The storage plane's degradation verdict for the overload
+        monitor: (level, reason). A live ENOSPC/EIO condition is
+        read-only regardless of watermarks; otherwise the free-bytes
+        watermark state machine answers."""
+        if self._full:
+            return self._READ_ONLY, "disk"
+        if self._disk_level > self._LIVE:
+            return self._disk_level, "disk"
+        return self._LIVE, ""
+
+    def set_defer_compaction(self, fn) -> None:
+        """Install the overload monitor's memory-pressure gate: while it
+        returns True the ticker defers snapshot compaction (the trigger
+        stays pending)."""
+        self._defer_compaction = fn
+
+    @property
+    def storage_full(self) -> bool:
+        return self._full
 
     # -- record ingestion ------------------------------------------------------
     def record_raw(self, raws: list[ChangeEventRaw]) -> None:
@@ -444,7 +642,19 @@ class DurableStore:
     def _append_many(self, recs: list[WalRecord]) -> None:
         if not recs or self._writer is None:
             return
-        n = self._writer.append_many(recs)
+        try:
+            n = self._writer.append_many(recs)
+        except StorageFullError as e:
+            # The disk, not the records, failed: the drain thread must
+            # SURVIVE (killing it would silently stop ALL journaling
+            # forever). The records stay live in the engine; the node
+            # degrades read-only via overload_level(), and the re-anchor
+            # snapshot on recovery restores their durability. Until then
+            # each dropped record is counted — a silent gap would read as
+            # "journaled" in every dashboard.
+            self._note_full(e)
+            get_metrics().inc("storage.records_dropped", len(recs))
+            return
         size = sum(len(r.key) + len(r.value or b"") + 25 for r in recs)
         self._bytes_since_snapshot += size
         m = get_metrics()
@@ -457,7 +667,12 @@ class DurableStore:
         if w is None:
             return
         t0 = time.perf_counter()
-        if w.fsync():
+        try:
+            synced = w.fsync()
+        except StorageFullError as e:
+            self._note_full(e)  # ticker survives; node degrades read-only
+            return
+        if synced:
             m = get_metrics()
             m.inc("storage.wal_fsyncs")
             # Fsync latency histogram (no log line — the ticker calls this
@@ -511,6 +726,9 @@ class DurableStore:
                 root,
             )
             self._bytes_since_snapshot = 0
+            # A whole snapshot fit on disk: genuine room, stop backing off.
+            self._probe_backoff_s = 0.0
+            self._next_probe_m = 0.0
             seconds = time.perf_counter() - t0
             out["items"] = len(items)
             out["root"] = root[:16]

@@ -36,6 +36,7 @@ record that also made it into a snapshot applies as a no-op.
 
 from __future__ import annotations
 
+import errno
 import os
 import re
 import struct
@@ -49,6 +50,7 @@ __all__ = [
     "OP_DEL",
     "OP_TRUNCATE",
     "SEGMENT_MAGIC",
+    "StorageFullError",
     "WalRecord",
     "SegmentScan",
     "encode_frame",
@@ -56,6 +58,9 @@ __all__ = [
     "list_segments",
     "segment_path",
     "WalWriter",
+    "set_io_hooks",
+    "io_write",
+    "io_fsync",
 ]
 
 OP_SET = 1
@@ -73,6 +78,65 @@ _U32 = struct.Struct("<I")
 _MAX_PAYLOAD = 1 << 28
 
 _SEGMENT_RE = re.compile(r"^wal-(\d{16})\.log$")
+
+
+class StorageFullError(OSError):
+    """A WAL write or fsync failed with a resource errno (ENOSPC / EIO /
+    EDQUOT). Typed so the durable store can degrade the NODE (read-only,
+    loud metric, ``/healthz``) instead of the error killing the drain
+    thread — the failure is about the disk, not the record. Carries the
+    original errno."""
+
+    def __init__(self, op: str, cause: OSError) -> None:
+        super().__init__(
+            cause.errno, f"WAL {op} failed: {cause.strerror or cause}"
+        )
+        self.op = op
+
+
+# Errnos that mean "the disk, not the caller": translated into
+# StorageFullError at the io seam below. Anything else propagates raw.
+_RESOURCE_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in ("ENOSPC", "EIO", "EDQUOT")
+    if hasattr(errno, name)
+)
+
+# -- io seam ----------------------------------------------------------------
+# Every WAL write/fsync routes through these module-level hooks. The
+# default is the real os call; tests install a deterministic errno
+# injector (testing/faults.WalErrnoInjector: fail the Nth write/fsync with
+# ENOSPC/EIO) through set_io_hooks — the chaos suite's disk-fault seam,
+# exercising the exact code path a real full disk takes without filling
+# one.
+io_write = os.write
+io_fsync = os.fsync
+
+
+def set_io_hooks(write=None, fsync=None) -> None:
+    """Install (or, with None, restore) the WAL io functions. Test seam —
+    production code never calls this."""
+    global io_write, io_fsync
+    io_write = write if write is not None else os.write
+    io_fsync = fsync if fsync is not None else os.fsync
+
+
+def _wal_write(fd: int, data: bytes, op: str = "write") -> None:
+    try:
+        io_write(fd, data)
+    except OSError as e:
+        if e.errno in _RESOURCE_ERRNOS:
+            raise StorageFullError(op, e) from e
+        raise
+
+
+def _wal_fsync(fd: int, op: str = "fsync") -> None:
+    try:
+        io_fsync(fd)
+    except OSError as e:
+        if e.errno in _RESOURCE_ERRNOS:
+            raise StorageFullError(op, e) from e
+        raise
 
 
 @dataclass(frozen=True)
@@ -274,9 +338,9 @@ class WalWriter:
             os.ftruncate(fd, start_offset)
             size = start_offset
         if size == 0:
-            os.write(fd, SEGMENT_MAGIC)
+            _wal_write(fd, SEGMENT_MAGIC, "segment-create")
             size = len(SEGMENT_MAGIC)
-            os.fsync(fd)
+            _wal_fsync(fd, "segment-create")
             _fsync_dir(self._dir)
         self._fd = fd
         self._size = size
@@ -289,7 +353,7 @@ class WalWriter:
 
     def _rotate_locked(self) -> int:
         if self._dirty and self._policy != "never":
-            os.fsync(self._fd)
+            _wal_fsync(self._fd)
             self.fsyncs += 1
             self._dirty = False
         os.close(self._fd)
@@ -305,12 +369,12 @@ class WalWriter:
                 SEGMENT_MAGIC
             ):
                 self._rotate_locked()
-            os.write(self._fd, frame)
+            _wal_write(self._fd, frame)
             self._size += len(frame)
             self.appended += 1
             self._dirty = True
             if self._policy == "always":
-                os.fsync(self._fd)
+                _wal_fsync(self._fd)
                 self.fsyncs += 1
                 self._dirty = False
 
@@ -329,7 +393,7 @@ class WalWriter:
                 if self._size + len(buf) + len(frame) > self._segment_bytes \
                         and self._size + len(buf) > len(SEGMENT_MAGIC):
                     if buf:
-                        os.write(self._fd, buf)
+                        _wal_write(self._fd, bytes(buf))
                         self._size += len(buf)
                         buf = bytearray()
                         # Mark before rotating so the closing segment gets
@@ -340,12 +404,12 @@ class WalWriter:
                 self.appended += 1
                 n += 1
             if buf:
-                os.write(self._fd, buf)
+                _wal_write(self._fd, bytes(buf))
                 self._size += len(buf)
             if n:
                 self._dirty = True
                 if self._policy == "always":
-                    os.fsync(self._fd)
+                    _wal_fsync(self._fd)
                     self.fsyncs += 1
                     self._dirty = False
         return n
@@ -355,7 +419,7 @@ class WalWriter:
         with self._mu:
             if not self._dirty:
                 return False
-            os.fsync(self._fd)
+            _wal_fsync(self._fd)
             self.fsyncs += 1
             self._dirty = False
             return True
@@ -369,7 +433,10 @@ class WalWriter:
             if self._fd < 0:
                 return
             if self._dirty and self._policy != "never":
-                os.fsync(self._fd)
-                self.fsyncs += 1
+                try:
+                    _wal_fsync(self._fd)
+                    self.fsyncs += 1
+                except StorageFullError:
+                    pass  # closing a full disk: nothing left to do
             os.close(self._fd)
             self._fd = -1

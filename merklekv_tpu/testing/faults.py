@@ -43,6 +43,7 @@ __all__ = [
     "FaultInjector",
     "FaultyTransport",
     "PeerProcessKiller",
+    "WalErrnoInjector",
     "truncate_file",
     "corrupt_file",
 ]
@@ -428,6 +429,107 @@ class FaultyTransport:
 
     def __getattr__(self, name):  # reconnects/outbox counters etc.
         return getattr(self._inner, name)
+
+
+class WalErrnoInjector:
+    """Deterministic resource-fault injection for the WAL io seam
+    (storage/wal.py ``set_io_hooks``) — the chaos suite's missing fault
+    class: a disk that fills or fails mid-burst.
+
+    Counts every WAL write/fsync; from the Nth call of the chosen kind on
+    (1-based), the call raises ``OSError(errno_)`` — ENOSPC by default —
+    until :meth:`heal` (the disk "fills" and stays full, the realistic
+    shape) or, with ``fail_count``, for exactly that many calls (a
+    transient EIO blip). The store's typed-error handling then drives the
+    node's read-only degradation and recovery WITHOUT a real full
+    filesystem, deterministically.
+
+    Usage::
+
+        inj = WalErrnoInjector(fail_write_at=3).install()
+        try:
+            ...  # third WAL write on raises StorageFullError upstream
+            inj.heal()   # disk "empties"; recovery probe succeeds
+        finally:
+            inj.uninstall()
+    """
+
+    def __init__(
+        self,
+        fail_write_at: Optional[int] = None,
+        fail_fsync_at: Optional[int] = None,
+        errno_: Optional[int] = None,
+        fail_count: Optional[int] = None,
+    ) -> None:
+        import errno as _errno
+        import os as _os
+
+        self._os = _os
+        self.errno = _errno.ENOSPC if errno_ is None else errno_
+        self._fail_write_at = fail_write_at
+        self._fail_fsync_at = fail_fsync_at
+        self._fail_count = fail_count  # None = until heal()
+        self._mu = threading.Lock()
+        self.writes = 0
+        self.fsyncs = 0
+        self.failures = 0
+        self._healed = False
+        self._installed = False
+
+    # -- hook bodies --------------------------------------------------------
+    def _should_fail(self, n: int, at: Optional[int]) -> bool:
+        if at is None or self._healed or n < at:
+            return False
+        if self._fail_count is not None and self.failures >= self._fail_count:
+            return False
+        return True
+
+    def _write(self, fd: int, data: bytes) -> int:
+        import os as _os
+
+        with self._mu:
+            self.writes += 1
+            if self._should_fail(self.writes, self._fail_write_at):
+                self.failures += 1
+                raise OSError(self.errno, _os.strerror(self.errno))
+        return self._os.write(fd, data)
+
+    def _fsync(self, fd: int) -> None:
+        import os as _os
+
+        with self._mu:
+            self.fsyncs += 1
+            if self._should_fail(self.fsyncs, self._fail_fsync_at):
+                self.failures += 1
+                raise OSError(self.errno, _os.strerror(self.errno))
+        self._os.fsync(fd)
+
+    # -- lifecycle ----------------------------------------------------------
+    def install(self) -> "WalErrnoInjector":
+        from merklekv_tpu.storage import wal as walmod
+
+        walmod.set_io_hooks(write=self._write, fsync=self._fsync)
+        self._installed = True
+        return self
+
+    def heal(self) -> None:
+        """Stop injecting (the disk 'empties'); counters keep running so
+        tests can assert how many ops happened post-recovery."""
+        with self._mu:
+            self._healed = True
+
+    def uninstall(self) -> None:
+        if self._installed:
+            from merklekv_tpu.storage import wal as walmod
+
+            walmod.set_io_hooks()  # restore the real os calls
+            self._installed = False
+
+    def __enter__(self) -> "WalErrnoInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
 
 
 def truncate_file(path: str, size: int) -> int:

@@ -130,3 +130,73 @@ def test_storage_rejects_bad_merkle_engine():
 
     with pytest.raises(ValueError, match="merkle_engine"):
         Config.from_dict({"storage": {"merkle_engine": "device"}})
+
+
+def test_server_overload_section_parse(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text(
+        """
+[server]
+max_connections = 4096
+max_pipeline = 256
+memory_soft_bytes = 1073741824
+memory_hard_bytes = 2147483648
+recovery_ratio = 0.9
+watermark_interval_seconds = 0.5
+
+[replication]
+max_skew_ms = 60000
+
+[storage]
+disk_free_soft_bytes = 268435456
+disk_free_hard_bytes = 67108864
+"""
+    )
+    cfg = Config.load(str(p))
+    assert cfg.server.max_connections == 4096
+    assert cfg.server.max_pipeline == 256
+    assert cfg.server.memory_soft_bytes == 1 << 30
+    assert cfg.server.memory_hard_bytes == 2 << 30
+    assert cfg.server.recovery_ratio == 0.9
+    assert cfg.server.watermark_interval_seconds == 0.5
+    assert cfg.replication.max_skew_ms == 60000
+    assert cfg.storage.disk_free_soft_bytes == 256 << 20
+    assert cfg.storage.disk_free_hard_bytes == 64 << 20
+
+
+def test_server_overload_defaults_off():
+    cfg = Config.from_dict({})
+    assert cfg.server.max_connections == 0
+    assert cfg.server.memory_soft_bytes == 0
+    assert cfg.server.memory_hard_bytes == 0
+    assert cfg.storage.disk_free_soft_bytes == 0
+    assert cfg.storage.disk_free_hard_bytes == 0
+    assert cfg.replication.max_skew_ms == 300_000  # skew guard defaults ON
+
+
+def test_server_overload_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="max_connections"):
+        Config.from_dict({"server": {"max_connections": -1}})
+    with pytest.raises(ValueError, match="memory_soft_bytes"):
+        # soft above hard: shedding could never precede read-only.
+        Config.from_dict(
+            {"server": {"memory_soft_bytes": 100, "memory_hard_bytes": 50}}
+        )
+    with pytest.raises(ValueError, match="recovery_ratio"):
+        Config.from_dict({"server": {"recovery_ratio": 1.5}})
+    with pytest.raises(ValueError, match="watermark_interval_seconds"):
+        Config.from_dict({"server": {"watermark_interval_seconds": 0}})
+    with pytest.raises(ValueError, match="max_skew_ms"):
+        Config.from_dict({"replication": {"max_skew_ms": -5}})
+    with pytest.raises(ValueError, match="disk_free_soft_bytes"):
+        # soft is the EARLIER (higher free-bytes) warning.
+        Config.from_dict(
+            {
+                "storage": {
+                    "disk_free_soft_bytes": 10,
+                    "disk_free_hard_bytes": 100,
+                }
+            }
+        )

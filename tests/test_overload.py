@@ -1,0 +1,899 @@
+"""Overload protection & graceful degradation (ISSUE 8).
+
+The degradation ladder (live -> shedding -> read_only -> draining), native
+admission control, memory/disk watermarks, the WAL errno-injection seam,
+the LWW clock-skew guard, typed client errors, and the overload chaos
+acceptance paths: a connection flood answers BUSY while established
+connections keep serving; a disk-full write burst degrades the node to
+read-only and recovers bit-identically once space returns; a future-ts
+poison frame is clamped and repaired.
+"""
+
+import socket
+import statistics
+import threading
+import time
+
+import pytest
+
+from merklekv_tpu.client import (
+    MerkleKVClient,
+    ConnectionError as MKVConnectionError,
+    ProtocolError,
+    ReadOnlyError,
+    ServerBusyError,
+)
+from merklekv_tpu.cluster.overload import (
+    DRAINING,
+    LIVE,
+    READ_ONLY,
+    SHEDDING,
+    DegradationLadder,
+    OverloadMonitor,
+)
+from merklekv_tpu.config import Config, ServerConfig, StorageConfig
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+from merklekv_tpu.utils.tracing import get_metrics
+
+
+@pytest.fixture
+def server():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    yield eng, srv
+    srv.close()
+    eng.close()
+
+
+def _counter(name: str) -> int:
+    return int(get_metrics().snapshot()["counters"].get(name, 0))
+
+
+# ------------------------------------------------------- admission control
+
+def _ping_p50_s(client: MerkleKVClient, n: int = 30) -> float:
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        client.ping()
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def test_connection_flood_answers_busy_within_one_rtt(server):
+    """Past max_connections every accept is answered BUSY and closed in
+    the accept loop itself (no handler thread), and established
+    connections' latency stays within 2x their pre-flood baseline."""
+    eng, srv = server
+    srv.set_limits(max_connections=2)
+    a = MerkleKVClient("127.0.0.1", srv.port, timeout=5).connect()
+    b = MerkleKVClient("127.0.0.1", srv.port, timeout=5).connect()
+    try:
+        assert a.ping().startswith("PONG")
+        assert b.ping().startswith("PONG")
+        base_p50 = _ping_p50_s(a)
+
+        # Flood: every excess connect is answered within one RTT.
+        for _ in range(20):
+            t0 = time.perf_counter()
+            c = MerkleKVClient("127.0.0.1", srv.port, timeout=2).connect()
+            try:
+                line = c._read_line()
+                assert line.startswith("ERROR BUSY connections"), line
+            finally:
+                c.close()
+            assert time.perf_counter() - t0 < 2.0
+
+        # The typed path: sending a request on a flooded connection reads
+        # the unsolicited BUSY answer as the response -> ServerBusyError,
+        # and the socket is already closed server-side.
+        c = MerkleKVClient("127.0.0.1", srv.port, timeout=2).connect()
+        with pytest.raises(ServerBusyError):
+            c.ping()
+        with pytest.raises(MKVConnectionError):
+            c.ping()
+        c.close()
+
+        # Established connections kept serving through the flood.
+        flood_stop = threading.Event()
+
+        def flood() -> None:
+            while not flood_stop.is_set():
+                try:
+                    s = socket.create_connection(
+                        ("127.0.0.1", srv.port), timeout=1
+                    )
+                    s.recv(64)
+                    s.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=flood, daemon=True)
+        t.start()
+        try:
+            during_p50 = _ping_p50_s(a)
+        finally:
+            flood_stop.set()
+            t.join(timeout=5)
+        assert during_p50 <= max(2 * base_p50, 0.010), (
+            f"p50 {during_p50 * 1e6:.0f}us vs baseline "
+            f"{base_p50 * 1e6:.0f}us under flood"
+        )
+        stats = a.stats()
+        assert int(stats["busy_rejected_connections"]) >= 21
+    finally:
+        a.close()
+        b.close()
+
+
+def test_pipeline_budget_closes_hostile_pipeliner(server):
+    """A connection buffering more unanswered pipelined commands than its
+    in-flight budget is answered BUSY and closed."""
+    eng, srv = server
+    srv.set_limits(max_connections=0, max_pipeline=8)
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    try:
+        s.sendall(b"PING\r\n" * 50)
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        assert b"ERROR BUSY pipeline" in data
+    finally:
+        s.close()
+    # A polite pipeliner under the budget is untouched.
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        assert c.pipeline(["PING"] * 8) == ["PONG "] * 8
+        assert int(c.stats()["pipeline_rejected"]) >= 1
+
+
+# --------------------------------------------------- degradation ladder
+
+def test_degradation_gate_sheds_writes_keeps_reads(server):
+    """shedding: writes BUSY (retryable), reads open; read_only: writes
+    READONLY; management plane (STATS/PING) open throughout; counters on
+    STATS; back to live serves everything."""
+    eng, srv = server
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        c.set("k", "v")
+        srv.set_degradation(1, 1)  # shedding / memory
+        with pytest.raises(ServerBusyError):
+            c.set("k2", "v")
+        with pytest.raises(ServerBusyError):
+            c.delete("k")
+        assert c.get("k") == "v"  # reads open
+        assert c.ping().startswith("PONG")
+        srv.set_degradation(2, 2)  # read_only / disk
+        with pytest.raises(ReadOnlyError):
+            c.set("k3", "v")
+        assert c.get("k") == "v"
+        stats = c.stats()
+        assert int(stats["shed_commands"]) >= 2
+        assert int(stats["readonly_commands"]) >= 1
+        assert stats["degradation"] == "2"
+        srv.set_degradation(0, 0)
+        c.set("k4", "v4")
+        assert c.get("k4") == "v4"
+
+
+def test_draining_refuses_new_connections(server):
+    eng, srv = server
+    keep = MerkleKVClient("127.0.0.1", srv.port).connect()
+    try:
+        # Round-trip BEFORE draining: connect() only completes the kernel
+        # handshake — without this the accept loop can process the socket
+        # after the rung flips and refuse it as a NEW connection.
+        assert keep.ping().startswith("PONG")
+        srv.set_degradation(3, 3)  # draining
+        c = MerkleKVClient("127.0.0.1", srv.port, timeout=2).connect()
+        assert c._read_line().startswith("ERROR BUSY draining")
+        c.close()
+        # Established connection: reads still served while draining.
+        assert keep.get("nope") is None
+        with pytest.raises(ReadOnlyError):
+            keep.set("x", "y")
+        srv.set_degradation(0, 0)
+    finally:
+        keep.close()
+
+
+def test_ladder_folds_max_of_sources():
+    ladder = DegradationLadder()
+    assert ladder.state() == (LIVE, "")
+    ladder.set_source("memory", SHEDDING, "memory")
+    assert ladder.state() == (SHEDDING, "memory")
+    ladder.set_source("disk", READ_ONLY, "disk")
+    assert ladder.state() == (READ_ONLY, "disk")
+    ladder.set_source("disk", LIVE)
+    assert ladder.state() == (SHEDDING, "memory")
+    ladder.set_source("memory", LIVE)
+    assert ladder.state() == (LIVE, "")
+    assert ladder.name() == "live"
+
+
+# ------------------------------------------------------ memory watermarks
+
+def test_memory_watermark_shedding_readonly_and_hysteresis(server):
+    """The monitor walks the node up the ladder as engine bytes cross the
+    soft then hard watermark, and back down only past the hysteresis
+    band (watermark * recovery_ratio)."""
+    eng, srv = server
+    base = eng.memory_usage()
+    cfg = ServerConfig(
+        memory_soft_bytes=base + 4096,
+        memory_hard_bytes=base + 8192,
+        recovery_ratio=0.5,
+    )
+    mon = OverloadMonitor(DegradationLadder(), eng, srv, cfg)
+    # Not started: poll_once() drives it deterministically.
+    assert mon.poll_once() == LIVE
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        c.set("small", "x")
+        assert mon.poll_once() == LIVE
+        # Cross the soft watermark.
+        for i in range(5):
+            c.set(f"soft:{i}", "y" * 1024)
+        assert mon.poll_once() == SHEDDING
+        with pytest.raises(ServerBusyError) as ei:
+            c.set("shed", "v")
+        assert "memory" in str(ei.value)
+        assert c.get("small") == "x"
+        # Cross the hard watermark (engine-direct: the server sheds
+        # client writes, exactly why runaway growth must come from
+        # elsewhere — replication applies, repairs).
+        for i in range(5):
+            eng.set(f"hard:{i}".encode(), b"z" * 1024)
+        assert mon.poll_once() == READ_ONLY
+        with pytest.raises(ReadOnlyError):
+            c.set("ro", "v")
+        # Recovery with hysteresis: dropping just below hard is NOT
+        # enough (recovery_ratio 0.5 -> must fall below half).
+        eng.delete_quiet(b"hard:0")
+        assert mon.poll_once() == READ_ONLY
+        for i in range(1, 5):
+            eng.delete_quiet(f"hard:{i}".encode())
+        for i in range(3):
+            eng.delete_quiet(f"soft:{i}".encode())
+        # Now ~2 KiB over base: below hard*0.5 (4 KiB over base)? hard/2
+        # relative math: usage must be < (base+8192)*0.5 in absolute
+        # terms only if base tiny — with base ~0 these bounds hold.
+        level = mon.poll_once()
+        assert level in (SHEDDING, LIVE)
+        for i in range(3, 5):
+            eng.delete_quiet(f"soft:{i}".encode())
+        eng.delete_quiet(b"small")
+        eng.delete_quiet(b"shed")
+        assert mon.poll_once() == LIVE
+        c.set("after", "v")
+        assert c.get("after") == "v"
+
+
+def test_memory_watermark_env_hook(server, monkeypatch):
+    """MKV_MAX_ENGINE_BYTES forces the hard watermark (soft = half) —
+    the chaos suite's deterministic memory-fault hook."""
+    eng, srv = server
+    monkeypatch.setenv("MKV_MAX_ENGINE_BYTES", "2048")
+    mon = OverloadMonitor(
+        DegradationLadder(), eng, srv, ServerConfig()
+    )
+    assert mon.poll_once() == LIVE
+    for i in range(3):
+        eng.set(f"b:{i}".encode(), b"x" * 1024)
+    assert mon.poll_once() == READ_ONLY
+    eng.truncate()
+    assert mon.poll_once() == LIVE
+
+
+# --------------------------------------------------------- typed errors
+
+class _CannedServer:
+    """One-shot TCP server answering every request line with a fixed
+    response — the degraded-server double for client typing tests."""
+
+    def __init__(self, responses: list[bytes]) -> None:
+        self._responses = list(responses)
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        try:
+            conn, _ = self._sock.accept()
+        except OSError:
+            return
+        buf = b""
+        while self._responses:
+            try:
+                data = conn.recv(65536)
+            except OSError:
+                break
+            if not data:
+                break
+            buf += data
+            while b"\n" in buf and self._responses:
+                _, _, buf = buf.partition(b"\n")
+                conn.sendall(self._responses.pop(0))
+        conn.close()
+
+    def close(self) -> None:
+        self._sock.close()
+        self._thread.join(timeout=5)
+
+
+def test_sync_client_raises_typed_busy_and_readonly():
+    srv = _CannedServer(
+        [b"ERROR BUSY memory retry\r\n", b"ERROR READONLY disk\r\n"]
+    )
+    try:
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            with pytest.raises(ServerBusyError) as busy:
+                c.set("k", "v")
+            with pytest.raises(ReadOnlyError) as ro:
+                c.set("k", "v")
+        # Both stay ProtocolError subclasses: existing handlers keep
+        # working, new callers get the retryability signal.
+        assert isinstance(busy.value, ProtocolError)
+        assert isinstance(ro.value, ProtocolError)
+        assert not isinstance(ro.value, ServerBusyError)
+    finally:
+        srv.close()
+
+
+def test_async_client_raises_typed_busy_and_readonly():
+    import asyncio
+
+    from merklekv_tpu.client import AsyncMerkleKVClient
+
+    srv = _CannedServer(
+        [b"ERROR BUSY connections retry\r\n", b"ERROR READONLY draining\r\n"]
+    )
+
+    async def run() -> None:
+        async with AsyncMerkleKVClient("127.0.0.1", srv.port) as c:
+            with pytest.raises(ServerBusyError):
+                await c.set("k", "v")
+            with pytest.raises(ReadOnlyError):
+                await c.set("k", "v")
+
+    try:
+        asyncio.run(run())
+    finally:
+        srv.close()
+
+
+def test_retry_policy_treats_busy_as_retryable():
+    from merklekv_tpu.cluster.retry import RETRYABLE_ERRORS, SERVER_BUSY
+
+    assert ServerBusyError in RETRYABLE_ERRORS
+    assert ReadOnlyError not in RETRYABLE_ERRORS
+    calls = {"n": 0}
+
+    def flaky() -> str:
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ServerBusyError("BUSY memory retry")
+        return "ok"
+
+    fast = SERVER_BUSY.with_overrides(first_delay=0.001, max_delay=0.002)
+    assert fast.run(flaky, retry_on=RETRYABLE_ERRORS) == "ok"
+    assert calls["n"] == 3
+
+
+# ------------------------------------------------ WAL errno injection seam
+
+def test_wal_errno_injector_write_and_fsync(tmp_path):
+    import errno
+
+    from merklekv_tpu.storage.wal import (
+        OP_SET,
+        StorageFullError,
+        WalRecord,
+        WalWriter,
+    )
+    from merklekv_tpu.testing.faults import WalErrnoInjector
+
+    w = WalWriter(str(tmp_path), 0, fsync_policy="always")
+    w.append(WalRecord(OP_SET, b"pre", b"1", 1))
+    inj = WalErrnoInjector(fail_write_at=2).install()
+    try:
+        # Injector counts from install: write 1 ok, write 2 on fails.
+        w.append(WalRecord(OP_SET, b"ok", b"2", 2))
+        with pytest.raises(StorageFullError) as ei:
+            w.append(WalRecord(OP_SET, b"boom", b"3", 3))
+        assert ei.value.errno == errno.ENOSPC
+        with pytest.raises(StorageFullError):
+            w.append(WalRecord(OP_SET, b"boom2", b"4", 4))
+        inj.heal()
+        w.append(WalRecord(OP_SET, b"post", b"5", 5))
+    finally:
+        inj.uninstall()
+        w.close()
+    # fsync-side injection, EIO flavor, exactly-once. The writer is
+    # created BEFORE install (segment creation fsyncs too — a real full
+    # disk fails there as well, but this case targets the steady state).
+    w2 = WalWriter(str(tmp_path / "b"), 0, fsync_policy="interval")
+    inj2 = WalErrnoInjector(
+        fail_fsync_at=1, errno_=errno.EIO, fail_count=1
+    ).install()
+    try:
+        w2.append(WalRecord(OP_SET, b"k", b"v", 1))
+        with pytest.raises(StorageFullError):
+            w2.fsync()
+        w2.append(WalRecord(OP_SET, b"k2", b"v", 2))
+        assert w2.fsync() is True  # fail_count=1: a transient blip
+        w2.close()
+    finally:
+        inj2.uninstall()
+
+
+def test_store_survives_disk_full_and_recovers(tmp_path):
+    """ENOSPC mid-burst: the drain path swallows the typed error (no dead
+    threads), the store reports read-only to the overload monitor, and
+    after the disk heals the probe recovers it and a re-anchor snapshot
+    restores durability of what the engine kept."""
+    from merklekv_tpu.storage.store import DurableStore
+    from merklekv_tpu.testing.faults import WalErrnoInjector
+
+    eng = NativeEngine("mem")
+    st = DurableStore(eng, StorageConfig(), str(tmp_path))
+    st.recover()
+    drops0 = _counter("storage.records_dropped")
+    # Mirror every record into the engine too (the real flows do: the
+    # engine is written first, then journaled) — the re-anchor snapshot
+    # captures ENGINE state, so only engine-resident keys can recover.
+    eng.set_with_ts(b"pre", b"1", 1)
+    st.record_set(b"pre", b"1", 1)
+    inj = WalErrnoInjector(fail_write_at=2).install()
+    try:
+        eng.set_with_ts(b"ok", b"2", 2)
+        st.record_set(b"ok", b"2", 2)  # write 1 since install
+        eng.set_with_ts(b"lost", b"3", 3)
+        st.record_set(b"lost", b"3", 3)  # fails inside; must NOT raise
+        assert st.storage_full
+        assert st.overload_level() == (2, "disk")
+        assert _counter("storage.records_dropped") > drops0
+        # Still full: the recovery probe fails through the same seam.
+        st._check_disk()
+        assert st.storage_full
+        inj.heal()
+        st._check_disk()
+        assert not st.storage_full
+        assert st.overload_level() == (0, "")
+        assert st._snapshot_requested  # re-anchor pending
+        # The re-anchor snapshot captures the engine state the journal
+        # missed: recovery from disk now restores the dropped record.
+        st.snapshot_now()
+        st._snapshot_requested = False
+    finally:
+        inj.uninstall()
+    st.stop()
+    eng2 = NativeEngine("mem")
+    st2 = DurableStore(eng2, StorageConfig(), str(tmp_path))
+    st2.recover()
+    assert eng2.get(b"lost") == b"3"
+    assert eng2.get(b"pre") == b"1"
+    st2.stop()
+    eng2.close()
+    eng.close()
+
+
+# ------------------------------------------------- disk-full chaos (node)
+
+def test_disk_full_degrades_node_then_reconverges(tmp_path):
+    """The acceptance loop, in process: a disk-full write burst degrades
+    the node to read-only with /healthz reflecting it; after space
+    returns the node goes back to live and an anti-entropy pass
+    converges both nodes' roots bit-identically — zero crashes."""
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.storage.store import DurableStore
+    from merklekv_tpu.testing.faults import WalErrnoInjector
+
+    eng_a = NativeEngine("mem")
+    srv_a = NativeServer(eng_a, "127.0.0.1", 0)
+    srv_a.start()
+    eng_b = NativeEngine("mem")
+    srv_b = NativeServer(eng_b, "127.0.0.1", 0)
+    srv_b.start()
+
+    cfg_a = Config()
+    cfg_a.server.watermark_interval_seconds = 0.02
+    cfg_a.storage.fsync_interval_seconds = 0.01
+    store = DurableStore(eng_a, cfg_a.storage, str(tmp_path / "a"))
+    store.recover()
+    node_a = ClusterNode(cfg_a, eng_a, srv_a, storage=store)
+    node_b = ClusterNode(Config(), eng_b, srv_b)
+    node_a.start()
+    node_b.start()
+    store.start()  # ticker: fsync + disk checks + recovery probe
+    inj = WalErrnoInjector(fail_write_at=5).install()
+    try:
+        with MerkleKVClient("127.0.0.1", srv_a.port) as ca:
+            # Burst until the node flips read-only (drain hits ENOSPC,
+            # monitor reacts within ~20ms).
+            deadline = time.time() + 10
+            flipped = False
+            i = 0
+            while time.time() < deadline and not flipped:
+                try:
+                    ca.set(f"burst:{i:05d}", f"v-{i}")
+                except (ServerBusyError, ReadOnlyError):
+                    flipped = True
+                    break
+                i += 1
+                if srv_a.degradation >= READ_ONLY:
+                    flipped = True
+            assert flipped or srv_a.degradation >= READ_ONLY, (
+                "node never degraded under injected ENOSPC"
+            )
+            assert node_a._health_payload()["degradation"] == "read_only"
+            assert node_a._health_payload()["status"] == "degraded"
+            # Reads keep serving while read-only.
+            assert ca.get("burst:00000") == "v-0"
+
+            # Space returns: the probe recovers the store, the monitor
+            # steps the node back to live.
+            inj.heal()
+            deadline = time.time() + 10
+            while time.time() < deadline and srv_a.degradation != LIVE:
+                time.sleep(0.02)
+            assert srv_a.degradation == LIVE
+            assert node_a._health_payload()["degradation"] == "live"
+            ca.set("after:0", "v")  # writes accepted again
+
+        # Anti-entropy pass: B := A (pairwise mirror) converges roots
+        # bit-identically, repairing the divergence the shed window left.
+        node_b.sync_manager.sync_once("127.0.0.1", srv_a.port)
+        with MerkleKVClient("127.0.0.1", srv_a.port) as ca, MerkleKVClient(
+            "127.0.0.1", srv_b.port
+        ) as cb:
+            assert ca.hash() == cb.hash()
+            assert cb.get("after:0") == "v"
+        assert _counter("storage.full_recoveries") >= 1
+    finally:
+        inj.uninstall()
+        node_a.stop()
+        node_b.stop()
+        store.stop()
+        srv_a.close()
+        srv_b.close()
+        eng_a.close()
+        eng_b.close()
+
+
+# ------------------------------------------------------- clock-skew guard
+
+class _NullTransport:
+    def publish(self, topic, payload):
+        pass
+
+    def subscribe(self, topic_prefix, callback):
+        pass
+
+    def unsubscribe(self, callback):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_future_ts_poison_frame_clamped_and_repaired(server):
+    """A frame stamped an hour in the future is clamped to now+skew
+    (counted, per-peer attributed) BEFORE journal/apply, so the key is
+    fenced for at most the skew window instead of forever."""
+    from merklekv_tpu.cluster.change_event import (
+        ChangeEvent,
+        OpKind,
+        encode_batch_cbor,
+    )
+    from merklekv_tpu.cluster.replicator import Replicator
+
+    eng, srv = server
+    rep = Replicator(
+        eng, srv, _NullTransport(), node_id="me", max_skew_ms=100
+    )
+    poison_ts = time.time_ns() + 3_600_000_000_000  # +1h
+    ev = ChangeEvent(
+        op=OpKind.SET, key="poisoned", val=b"evil", ts=poison_ts, src="liar"
+    )
+    payload = encode_batch_cbor(
+        [ev], "liar", hwm_seq=1, hwm_ts=time.time_ns()
+    )
+    before = _counter("replicator.skew_clamped")
+    rep._on_message("t/events", payload)
+    assert eng.get(b"poisoned") == b"evil"
+    installed_ts = eng.get_ts(b"poisoned")
+    assert installed_ts is not None
+    assert installed_ts <= time.time_ns() + 150_000_000  # ~now + skew
+    assert rep.skew_clamped == 1
+    assert _counter("replicator.skew_clamped") == before + 1
+    assert _counter("replicator.skew_clamped.liar") >= 1
+    # Repaired: once the skew window passes, an honest write wins LWW.
+    time.sleep(0.15)
+    assert eng.set_if_newer(b"poisoned", b"honest", time.time_ns())
+    assert eng.get(b"poisoned") == b"honest"
+    # Disabled guard (max_skew_ms=0) leaves timestamps untouched.
+    rep0 = Replicator(
+        eng, srv, _NullTransport(), node_id="me", max_skew_ms=0
+    )
+    ev2 = ChangeEvent(
+        op=OpKind.SET, key="raw", val=b"x", ts=poison_ts, src="liar"
+    )
+    rep0._on_message(
+        "t/events",
+        encode_batch_cbor([ev2], "liar", hwm_seq=1, hwm_ts=time.time_ns()),
+    )
+    assert eng.get_ts(b"raw") == poison_ts
+
+
+def test_anti_entropy_repair_clamps_poisoned_peer_ts(server):
+    """The skew guard also gates the repair-install boundary: a walk
+    against the poisoning peer (which still holds the raw future ts in
+    its engine) must not re-import what the replication clamp refused."""
+    from merklekv_tpu.cluster.sync import SyncManager
+
+    eng, srv = server  # the "local" node
+    peer_eng = NativeEngine("mem")
+    peer_srv = NativeServer(peer_eng, "127.0.0.1", 0)
+    peer_srv.start()
+    try:
+        poison_ts = time.time_ns() + 3_600_000_000_000  # +1h on the peer
+        peer_eng.set_with_ts(b"poisoned", b"evil", poison_ts)
+        mgr = SyncManager(eng, device="cpu", max_skew_ms=100)
+        before = _counter("anti_entropy.skew_clamped")
+        mgr.sync_once("127.0.0.1", peer_srv.port)
+        assert eng.get(b"poisoned") == b"evil"  # value adopted...
+        ts = eng.get_ts(b"poisoned")
+        assert ts is not None and ts <= time.time_ns() + 150_000_000
+        assert _counter("anti_entropy.skew_clamped") > before
+        # ...and an honest write wins once the skew window passes.
+        time.sleep(0.15)
+        assert eng.set_if_newer(b"poisoned", b"honest", time.time_ns())
+    finally:
+        peer_srv.close()
+        peer_eng.close()
+
+
+def test_full_disk_probe_backs_off_after_flapped_recovery(tmp_path):
+    """A probe that succeeds while the re-anchor snapshot still cannot
+    fit must not flap latch->recover->latch every tick: re-latching
+    right after a recovery arms an escalating probe backoff, reset only
+    by a snapshot that actually completes."""
+    from merklekv_tpu.storage.store import DurableStore
+    from merklekv_tpu.testing.faults import WalErrnoInjector
+
+    eng = NativeEngine("mem")
+    st = DurableStore(eng, StorageConfig(), str(tmp_path))
+    st.recover()
+    inj = WalErrnoInjector(fail_write_at=1).install()
+    try:
+        st.record_set(b"k", b"v", 1)
+        assert st.storage_full
+        inj.heal()
+        st._check_disk()  # probe succeeds -> recovered
+        assert not st.storage_full
+        # The "re-anchor" write fails again (disk refilled instantly).
+        inj2 = WalErrnoInjector(fail_write_at=1).install()
+        st.record_set(b"k2", b"v", 2)
+        assert st.storage_full
+        assert st._probe_backoff_s >= 2.0  # flap detected: backoff armed
+        inj2.heal()
+        st._check_disk()
+        assert st.storage_full  # still latched: probe deferred by backoff
+        st._next_probe_m = 0.0  # (simulate the backoff elapsing)
+        st._check_disk()
+        assert not st.storage_full
+        st.snapshot_now()  # a COMPLETED snapshot resets the backoff
+        assert st._probe_backoff_s == 0.0
+    finally:
+        inj.uninstall()
+        st.stop()
+        eng.close()
+
+
+# ------------------------------------------- event-queue observability
+
+def test_event_queue_depth_and_drops_observable(server):
+    """events.queue_depth / events.dropped travel on STATS, bridge into
+    /metrics with catalog metadata, and move with the queue."""
+    from merklekv_tpu.obs.catalog import CATALOG
+    from merklekv_tpu.obs.exporter import render_prometheus
+
+    eng, srv = server
+    srv.enable_events(True)
+    with MerkleKVClient("127.0.0.1", srv.port) as c:
+        for i in range(5):
+            c.set(f"q:{i}", "v")
+        stats = c.stats()
+        assert int(stats["events_queue_depth"]) == 5
+        assert stats["events_dropped"].isdigit()
+        assert srv.events_depth() == 5
+        srv.drain_events()
+        assert int(c.stats()["events_queue_depth"]) == 0
+    page = render_prometheus(get_metrics(), srv.stats_text())
+    assert "mkv_native_events_queue_depth" in page
+    assert "mkv_native_events_dropped" in page
+    assert "# TYPE mkv_native_events_dropped counter" in page
+    assert "# TYPE mkv_native_events_queue_depth gauge" in page
+    assert "native.events_queue_depth" in CATALOG
+    assert "native.events_dropped" in CATALOG
+
+
+# ------------------------------------------------ background-work yielding
+
+def test_sync_loop_defers_cycles_under_overload(server):
+    from merklekv_tpu.cluster.sync import SyncManager
+
+    eng, srv = server
+    mgr = SyncManager(eng, device="cpu")
+    before_skips = _counter("anti_entropy.overload_skips")
+    before_errors = _counter("anti_entropy.loop_errors")
+    mgr.start_loop(
+        ["127.0.0.1:1"],  # a dead peer: a RUN cycle would error loudly
+        0.02,
+        pause_when=lambda: True,
+    )
+    try:
+        time.sleep(0.3)
+    finally:
+        mgr.stop()
+    assert _counter("anti_entropy.overload_skips") >= before_skips + 3
+    assert _counter("anti_entropy.loop_errors") == before_errors
+
+
+def test_compaction_defers_under_memory_pressure(tmp_path):
+    from merklekv_tpu.storage.store import DurableStore
+
+    eng = NativeEngine("mem")
+    st = DurableStore(
+        eng,
+        StorageConfig(
+            fsync_interval_seconds=0.01, compact_trigger_bytes=64
+        ),
+        str(tmp_path),
+    )
+    st.recover()
+    gate = {"pressure": True}
+    st.set_defer_compaction(lambda: gate["pressure"])
+    st.start()
+    before = _counter("storage.compactions_deferred")
+    try:
+        for i in range(10):
+            st.record_set(f"k:{i}".encode(), b"v" * 64, i + 1)
+        deadline = time.time() + 5
+        while (
+            time.time() < deadline
+            and _counter("storage.compactions_deferred") == before
+        ):
+            time.sleep(0.02)
+        assert _counter("storage.compactions_deferred") > before
+        snaps_before = _counter("storage.snapshots")
+        gate["pressure"] = False  # pressure released: trigger still fires
+        deadline = time.time() + 5
+        while (
+            time.time() < deadline
+            and _counter("storage.snapshots") == snaps_before
+        ):
+            time.sleep(0.02)
+        assert _counter("storage.snapshots") > snaps_before
+    finally:
+        st.stop()
+        eng.close()
+
+
+# ----------------------------------------------------- METRICS / healthz
+
+def test_node_metrics_lines_and_gauge(server):
+    from merklekv_tpu.cluster.node import ClusterNode
+
+    eng, srv = server
+    node = ClusterNode(Config(), eng, srv)
+    node.start()
+    try:
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            m = c.metrics()
+            assert m.get("node.degradation") == "0"
+            assert "node.shed_total" in m
+            assert "node.readonly_rejected" in m
+            # All values stay integer text (the METRICS block contract).
+            assert all(v.lstrip("-").isdigit() for v in m.values()), m
+            srv.set_degradation(1, 1)
+            with pytest.raises(ServerBusyError):
+                c.set("x", "y")
+            m = c.metrics()
+            assert int(m["node.shed_total"]) >= 1
+            payload = node._health_payload()
+            assert payload["degradation"] == "live"  # ladder, not admin push
+    finally:
+        srv.set_degradation(0, 0)
+        node.stop()
+
+
+def test_bench_gate_direction_for_overload_goodput():
+    import sys
+    import os
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    from bench_gate import lower_is_better
+
+    # Goodput is throughput: DROPPING it is the regression.
+    assert lower_is_better(
+        "overload_goodput", "ops/s (accepted under ~2x offered load)"
+    ) is False
+
+
+# ------------------------------------------------------------- slow soak
+
+@pytest.mark.slow
+def test_soak_repeated_disk_full_cycles(tmp_path):
+    """Inject-heal ENOSPC repeatedly; every cycle must degrade, recover,
+    and keep the store's journal consistent with the engine."""
+    from merklekv_tpu.storage.store import DurableStore
+    from merklekv_tpu.testing.faults import WalErrnoInjector
+
+    eng = NativeEngine("mem")
+    st = DurableStore(eng, StorageConfig(), str(tmp_path))
+    st.recover()
+    try:
+        for cycle in range(5):
+            inj = WalErrnoInjector(fail_write_at=1).install()
+            try:
+                for i in range(20):
+                    ts = cycle * 1000 + i + 1
+                    eng.set_with_ts(f"c{cycle}:{i}".encode(), b"v", ts)
+                    st.record_set(f"c{cycle}:{i}".encode(), b"v", ts)
+                assert st.storage_full
+                inj.heal()
+                st._check_disk()
+                assert not st.storage_full
+                st.snapshot_now()
+                st._snapshot_requested = False
+            finally:
+                inj.uninstall()
+        st.stop()
+        eng2 = NativeEngine("mem")
+        st2 = DurableStore(eng2, StorageConfig(), str(tmp_path))
+        st2.recover()
+        for cycle in range(5):
+            for i in range(20):
+                assert eng2.get(f"c{cycle}:{i}".encode()) == b"v", (cycle, i)
+        st2.stop()
+        eng2.close()
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_soak_connection_flood_cycles(server):
+    """Repeated flood rounds: the server neither leaks handler threads
+    nor stops serving its established connections."""
+    eng, srv = server
+    srv.set_limits(max_connections=2)
+    a = MerkleKVClient("127.0.0.1", srv.port).connect()
+    b = MerkleKVClient("127.0.0.1", srv.port).connect()
+    try:
+        assert a.ping().startswith("PONG")
+        assert b.ping().startswith("PONG")  # both slots occupied
+        for _ in range(5):
+            for _ in range(50):
+                try:
+                    s = socket.create_connection(
+                        ("127.0.0.1", srv.port), timeout=1
+                    )
+                    s.recv(64)
+                    s.close()
+                except OSError:
+                    pass
+            assert a.ping().startswith("PONG")
+        assert int(a.stats()["busy_rejected_connections"]) >= 250
+        assert int(a.stats()["active_connections"]) <= 3
+    finally:
+        a.close()
+        b.close()
