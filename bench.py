@@ -31,7 +31,10 @@ The remaining BASELINE.json configs print one JSON line each on STDERR
   - replicated_write_throughput: 2-node replication pipeline A/B — events/s
     from ingest to converged device roots, batched envelope frames + native
     batch apply vs per-event publish/apply, with the replicator.batch_size
-    histogram snapshot embedded in the record.
+    histogram snapshot embedded in the record;
+  - many_conn_throughput: native-server I/O plane A/B — aggregate ops/s +
+    p99 burst round-trip for 64 pipelined connections against the epoll
+    worker pool vs the io_threads=1 unpipelined compat baseline.
 
 Off-TPU the sizes shrink to smoke-test values so the script stays runnable
 in CI; the driver's real run happens on the chip.
@@ -48,12 +51,6 @@ import time
 import numpy as np
 
 R = 8  # replicas in the headline diff
-
-
-def _on_tpu() -> bool:
-    import jax
-
-    return jax.default_backend() == "tpu"
 
 
 def _resolve_backend() -> str:
@@ -651,6 +648,133 @@ def bench_op_latency(n_ops: int) -> dict:
         eng.close()
 
 
+def bench_many_conn_throughput(
+    n_conns: int = 64, depth: int = 32, bursts: int = 25
+) -> dict:
+    """Epoll worker-pool I/O plane A/B (ISSUE 9 tentpole evidence).
+
+    Drives n_conns concurrent connections, each sending pipelined bursts
+    of `depth` commands (~50/50 GET/SET over a pre-seeded keyspace, every
+    response single-line), and measures aggregate ops/s plus p99 burst
+    round-trip. Runs the same load twice: the pooled pipelined plane
+    (io_threads = hardware concurrency, coalesced writev responses) vs a
+    compat server (io_threads=1, one write syscall per response) that
+    approximates the old thread-per-connection blocking loop from the
+    wire side. The client is shared and deliberately thin — raw sockets,
+    pre-built request bytes, newline counting — so the measured ratio is
+    the server's, not the driver's. value = pooled ops/s ("/s" reads
+    up-good in tools/bench_gate.py); the compat baseline and speedup ride
+    as side fields, target >= 3x aggregate on CPU."""
+    import socket
+    import threading
+
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    val = b"v" * 64
+    n_keys = 4096
+
+    def run(io_threads: int, pipelined: bool) -> tuple[float, float, int]:
+        eng = NativeEngine("mem")
+        srv = NativeServer(
+            eng, "127.0.0.1", 0, io_threads=io_threads, pipelined=pipelined
+        )
+        srv.start()
+        try:
+            for i in range(n_keys):
+                eng.set(b"mc:%05d" % i, val)
+            payloads = []
+            for c in range(n_conns):
+                cmds = []
+                for j in range(depth):
+                    k = b"mc:%05d" % ((c * 131 + j * 17) % n_keys)
+                    if j % 2:
+                        cmds.append(b"GET " + k + b"\r\n")
+                    else:
+                        cmds.append(b"SET " + k + b" " + val + b"\r\n")
+                payloads.append(b"".join(cmds))
+            socks = [
+                socket.create_connection(("127.0.0.1", srv.port), timeout=30)
+                for _ in range(n_conns)
+            ]
+            burst_ns: list[list[int]] = [[] for _ in range(n_conns)]
+            n_threads = min(8, n_conns)
+            per = (n_conns + n_threads - 1) // n_threads
+            start_evt = threading.Event()
+            errors: list[BaseException] = []
+
+            def driver(t: int) -> None:
+                # One thread multiplexes a slice of the connections:
+                # launch every burst in its slice, then collect — all of
+                # them stay in flight together on the wire.
+                mine = range(t * per, min((t + 1) * per, n_conns))
+                buf = bytearray(1 << 16)
+                try:
+                    start_evt.wait()
+                    for _ in range(bursts):
+                        t0s = {}
+                        for ci in mine:
+                            t0s[ci] = time.perf_counter_ns()
+                            socks[ci].sendall(payloads[ci])
+                        for ci in mine:
+                            got = 0
+                            while got < depth:
+                                n = socks[ci].recv_into(buf)
+                                if n == 0:
+                                    raise ConnectionError("server closed")
+                                got += buf.count(b"\n", 0, n)
+                            burst_ns[ci].append(
+                                time.perf_counter_ns() - t0s[ci]
+                            )
+                except BaseException as e:  # surfaced after join
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=driver, args=(t,), daemon=True)
+                for t in range(n_threads)
+            ]
+            for th in threads:
+                th.start()
+            t0 = time.perf_counter()
+            start_evt.set()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            for s in socks:
+                s.close()
+            if errors:
+                raise errors[0]
+            total = n_conns * depth * bursts
+            all_ns = sorted(ns for per_c in burst_ns for ns in per_c)
+            p99_ms = (
+                all_ns[min(int(0.99 * (len(all_ns) - 1)), len(all_ns) - 1)]
+                / 1e6
+            )
+            return total / dt, p99_ms, srv.io_threads
+        finally:
+            srv.close()
+            eng.close()
+
+    pooled_rate, pooled_p99_ms, workers = run(0, True)
+    compat_rate, compat_p99_ms, _ = run(1, False)
+    speedup = pooled_rate / max(compat_rate, 1e-9)
+    return {
+        "metric": "many_conn_throughput",
+        "value": round(pooled_rate, 1),
+        "unit": f"ops/s ({n_conns} conns x pipelined GET/SET, depth {depth})",
+        "conns": n_conns,
+        "depth": depth,
+        "bursts_per_conn": bursts,
+        "io_threads": workers,
+        "pooled_ops_per_s": round(pooled_rate, 1),
+        "pooled_burst_p99_ms": round(pooled_p99_ms, 3),
+        "compat_ops_per_s": round(compat_rate, 1),
+        "compat_burst_p99_ms": round(compat_p99_ms, 3),
+        "speedup_x": round(speedup, 2),
+        "target": 3.0,
+        "target_met": speedup >= 3.0,
+    }
+
+
 def bench_overload_goodput(duration_s: float = 1.5) -> dict:
     """Overload protection under ~2x offered load: goodput, shed rate, and
     read p99 while the node sheds writes above its memory watermark.
@@ -945,6 +1069,12 @@ def _run(backend: str) -> None:
         configs.append(bench_overload_goodput())
     except Exception as e:
         print(f"# overload_goodput bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(
+            bench_many_conn_throughput(bursts=60 if on_tpu else 25)
+        )
+    except Exception as e:
+        print(f"# many_conn_throughput bench failed: {e!r}", file=sys.stderr)
 
     # Every emitted record carries the run's metrics snapshot (counters +
     # span aggregates) so a BENCH_*.json trajectory shows what the run

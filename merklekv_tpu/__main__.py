@@ -109,7 +109,8 @@ def main(argv: list[str] | None = None) -> int:
             recovery = storage.recover()
 
     server = NativeServer(
-        engine, cfg.host, cfg.port, version=__version__, exit_on_shutdown=False
+        engine, cfg.host, cfg.port, version=__version__,
+        exit_on_shutdown=False, io_threads=cfg.server.io_threads,
     )
     if cfg.storage.enabled:
         # BEFORE start(): stage change events from the very first accepted

@@ -129,9 +129,14 @@ class ServerConfig:
     docs/DEPLOYMENT.md for capacity planning.
     """
 
+    # Epoll worker-pool width (the I/O plane): every accepted connection
+    # is owned by exactly one of io_threads event-loop workers. 0 (the
+    # default) sizes the pool to hardware concurrency; 1 keeps a single
+    # loop. See docs/DEPLOYMENT.md "I/O plane sizing".
+    io_threads: int = 0
     # Accepted-connection cap: past it, excess accepts are answered
-    # "ERROR BUSY connections retry" and closed WITHOUT spawning a handler
-    # thread. 0 = unlimited.
+    # "ERROR BUSY connections retry" and closed without ever entering the
+    # worker pool. 0 = unlimited.
     max_connections: int = 0
     # One connection's in-flight pipelined-command budget: a client that
     # buffers more unanswered complete lines than this is answered BUSY
@@ -359,6 +364,7 @@ class Config:
                 cfg.anti_entropy.interval_seconds = cfg.sync_interval_seconds
         srv = raw.get("server", {})
         for k in (
+            "io_threads",
             "max_connections",
             "max_pipeline",
             "memory_soft_bytes",
@@ -366,6 +372,11 @@ class Config:
         ):
             if k in srv:
                 setattr(cfg.server, k, int(srv[k]))
+        if cfg.server.io_threads < 0:
+            raise ValueError(
+                "[server] io_threads must be >= 0 (0 = hardware "
+                f"concurrency), got {cfg.server.io_threads}"
+            )
         if "recovery_ratio" in srv:
             cfg.server.recovery_ratio = float(srv["recovery_ratio"])
         if "watermark_interval_seconds" in srv:

@@ -397,6 +397,20 @@ void* mkv_server_create(void* engine, const char* host, int port,
   return hs;
 }
 
+// I/O-plane shape, set BEFORE mkv_server_start (ignored after):
+// io_threads 0 = hardware concurrency, 1 = single event loop; pipelined 0
+// restores the per-response-write compat discipline (the bench's A/B
+// baseline approximating the old thread-per-connection loop).
+void mkv_server_configure_io(void* h, long long io_threads, int pipelined) {
+  static_cast<ServerHandle*>(h)->server->configure_io(
+      io_threads < 0 ? 0 : size_t(io_threads), pipelined != 0);
+}
+
+// Resolved worker-pool width (0 before start).
+long long mkv_server_io_threads(void* h) {
+  return (long long)static_cast<ServerHandle*>(h)->server->io_threads();
+}
+
 int mkv_server_start(void* h) {
   return static_cast<ServerHandle*>(h)->server->start() ? 1 : 0;
 }

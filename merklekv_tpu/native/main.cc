@@ -18,6 +18,7 @@ int main(int argc, char** argv) {
   int port = 7379;
   std::string engine_kind = "mem";
   std::string storage_path = "merklekv_data";
+  long long io_threads = 0;  // 0 = hardware concurrency
 
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -36,10 +37,12 @@ int main(int argc, char** argv) {
       engine_kind = next("--engine");
     } else if (a == "--storage-path") {
       storage_path = next("--storage-path");
+    } else if (a == "--io-threads") {
+      io_threads = std::atoll(next("--io-threads"));
     } else if (a == "--help" || a == "-h") {
       std::printf(
           "usage: merklekv-server [--host H] [--port P] "
-          "[--engine mem|log] [--storage-path DIR]\n");
+          "[--engine mem|log] [--storage-path DIR] [--io-threads N]\n");
       return 0;
     } else {
       std::fprintf(stderr, "unknown flag: %s\n", a.c_str());
@@ -52,6 +55,7 @@ int main(int argc, char** argv) {
   opts.host = host;
   opts.port = uint16_t(port);
   opts.exit_on_shutdown = true;
+  opts.io_threads = io_threads < 0 ? 0 : size_t(io_threads);
   mkv::Server server(engine.get(), opts);
   if (!server.start()) {
     std::fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);

@@ -1,9 +1,12 @@
 #include "server.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/epoll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -12,6 +15,7 @@
 #include <cstring>
 #include <ctime>
 #include <functional>
+#include <unordered_map>
 
 #include "merkle.h"
 #include "protocol.h"
@@ -41,6 +45,8 @@ const char* traced_verb_name(Verb v) {
   }
 }
 
+// Blocking write for the accept-loop admission answers only (the fd is
+// still blocking there; worker-owned sockets flush through OutQueue).
 bool send_all(int fd, const std::string& data) {
   size_t off = 0;
   while (off < data.size()) {
@@ -88,6 +94,426 @@ bool is_write_verb(Verb v) {
 
 }  // namespace
 
+// ------------------------------------------------------------- IoWorker
+//
+// One epoll event loop owning a fixed subset of the connections. All of a
+// connection's state (input carry, pipeline budget, out queue, interest
+// flags) is touched by this thread ONLY — the cross-thread surface is the
+// inbox (accept loop hands fds over) and the atomic counters.
+class IoWorker {
+ public:
+  IoWorker(Server* srv, size_t idx) : srv_(srv), ws_(srv->worker_stats_[idx]) {}
+
+  ~IoWorker() {
+    join_thread();
+    release();
+  }
+
+  bool start() {
+    epfd_ = ::epoll_create1(EPOLL_CLOEXEC);
+    if (epfd_ < 0) return false;
+    int p[2];
+    if (::pipe2(p, O_NONBLOCK | O_CLOEXEC) != 0) {
+      ::close(epfd_);
+      epfd_ = -1;
+      return false;
+    }
+    wake_r_ = p[0];
+    wake_w_ = p[1];
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = wake_r_;
+    ::epoll_ctl(epfd_, EPOLL_CTL_ADD, wake_r_, &ev);
+    th_ = std::thread([this] { loop(); });
+    return true;
+  }
+
+  // Hand an accepted (already registered, nonblocking) fd to this worker.
+  void submit(int fd, std::shared_ptr<ClientMeta> meta) {
+    {
+      std::lock_guard lk(inbox_mu_);
+      inbox_.push_back({fd, std::move(meta)});
+    }
+    wake();
+  }
+
+  void wake() {
+    char b = 1;
+    // Nonblocking pipe: a full pipe already guarantees a pending wakeup.
+    ssize_t r = ::write(wake_w_, &b, 1);
+    (void)r;
+  }
+
+  // Teardown is two-phase so no fd closes while ANY worker thread can
+  // still wake() a sibling (a SHUTDOWN-ing worker runs stop() — which
+  // pokes every worker's wake pipe — from inside its own loop):
+  // join_thread() for EVERY worker first, release() after.
+  void join_thread() {
+    if (th_.joinable()) th_.join();
+  }
+
+  // Release every fd this worker still references — connections it owned
+  // plus inbox handoffs that raced shutdown. Only after all joins.
+  void release() {
+    for (auto& [fd, c] : conns_) {
+      (void)c;
+      deregister(*c);
+      ::close(fd);
+    }
+    conns_.clear();
+    std::lock_guard lk(inbox_mu_);
+    for (auto& p : inbox_) drop_pending(p);
+    inbox_.clear();
+    if (wake_r_ >= 0) ::close(wake_r_);
+    if (wake_w_ >= 0) ::close(wake_w_);
+    if (epfd_ >= 0) ::close(epfd_);
+    wake_r_ = wake_w_ = epfd_ = -1;
+  }
+
+ private:
+  struct Pending {
+    int fd;
+    std::shared_ptr<ClientMeta> meta;
+  };
+
+  struct Conn {
+    int fd = -1;
+    std::shared_ptr<ClientMeta> meta;
+    std::string in;       // partial frame carried across reads
+    size_t pending = 0;   // complete-but-unanswered lines buffered
+    OutQueue out;
+    bool want_write = false;   // EPOLLOUT armed (flush hit EAGAIN)
+    bool read_paused = false;  // backpressure: out backlog past the HWM
+    bool closing = false;      // flush what is queued, then close
+    bool shutdown_req = false; // SHUTDOWN verb: act after the flush
+  };
+
+  enum class FlushResult { kDone, kBlocked, kError };
+
+  // Intake cap per readable event: past this the worker round-robins to
+  // its other connections (level-triggered epoll re-signals the rest).
+  static constexpr size_t kMaxIntake = 256 * 1024;
+  // Output backlog watermarks (hysteresis, applied while the socket is
+  // write-blocked): past kOutHigh the connection stops being READ (a
+  // reader that never drains cannot grow the queue without bound); once
+  // the backlog falls below kOutLow reading resumes.
+  static constexpr size_t kOutHigh = 8u << 20;
+  static constexpr size_t kOutLow = 1u << 20;
+  static constexpr size_t kMaxIov = 64;
+
+  void loop() {
+    epoll_event evs[128];
+    for (;;) {
+      int n = ::epoll_wait(epfd_, evs, 128, -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      if (n > 0) ws_.wakeups.fetch_add(1, std::memory_order_relaxed);
+      bool woken = false;
+      for (int i = 0; i < n; ++i) {
+        const int fd = evs[i].data.fd;
+        if (fd == wake_r_) {
+          char buf[256];
+          while (::read(wake_r_, buf, sizeof(buf)) > 0) {
+          }
+          woken = true;
+          continue;
+        }
+        auto it = conns_.find(fd);
+        if (it == conns_.end()) continue;
+        Conn& c = *it->second;
+        const uint32_t e = evs[i].events;
+        bool alive = (e & EPOLLERR) == 0;
+        if (alive && (e & EPOLLOUT)) alive = drive(c);
+        if (alive && !c.read_paused && (e & (EPOLLIN | EPOLLHUP))) {
+          alive = on_readable(c);
+        }
+        if (!alive) destroy(it);
+      }
+      if (woken) adopt_inbox();
+      if (srv_->stop_.load(std::memory_order_acquire)) break;
+    }
+  }
+
+  void adopt_inbox() {
+    std::vector<Pending> pend;
+    {
+      std::lock_guard lk(inbox_mu_);
+      pend.swap(inbox_);
+    }
+    for (auto& p : pend) {
+      if (srv_->stop_.load(std::memory_order_acquire)) {
+        drop_pending(p);
+        continue;
+      }
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = p.fd;
+      if (::epoll_ctl(epfd_, EPOLL_CTL_ADD, p.fd, &ev) != 0) {
+        drop_pending(p);
+        continue;
+      }
+      auto c = std::make_unique<Conn>();
+      c->fd = p.fd;
+      c->meta = std::move(p.meta);
+      ws_.connections.fetch_add(1, std::memory_order_relaxed);
+      conns_[p.fd] = std::move(c);
+    }
+  }
+
+  // Undo the accept loop's registration for a connection that never made
+  // it into (or is leaving) the event loop.
+  void drop_pending(const Pending& p) {
+    {
+      std::lock_guard lk(srv_->clients_mu_);
+      srv_->clients_.erase(p.meta->id);
+    }
+    ::close(p.fd);
+    srv_->stats_.active_connections--;
+  }
+
+  void deregister(Conn& c) {
+    {
+      std::lock_guard lk(srv_->clients_mu_);
+      srv_->clients_.erase(c.meta->id);
+    }
+    srv_->stats_.active_connections--;
+    ws_.connections.fetch_sub(1, std::memory_order_relaxed);
+  }
+
+  void destroy(std::unordered_map<int, std::unique_ptr<Conn>>::iterator it) {
+    Conn& c = *it->second;
+    ::epoll_ctl(epfd_, EPOLL_CTL_DEL, c.fd, nullptr);
+    deregister(c);
+    ::close(c.fd);
+    conns_.erase(it);
+  }
+
+  void update_interest(Conn& c) {
+    epoll_event ev{};
+    ev.events = (c.read_paused ? 0u : uint32_t(EPOLLIN)) |
+                (c.want_write ? uint32_t(EPOLLOUT) : 0u);
+    ev.data.fd = c.fd;
+    ::epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
+  }
+
+  bool on_readable(Conn& c) {
+    // A closing connection only waits out its flush; nothing it sends
+    // will be parsed, so don't let it grow the input buffer either.
+    if (c.closing) return drive(c);
+    char chunk[65536];
+    size_t got = 0;
+    bool eof = false;
+    for (;;) {
+      ssize_t r = ::recv(c.fd, chunk, sizeof(chunk), 0);
+      if (r > 0) {
+        for (ssize_t i = 0; i < r; ++i) {
+          if (chunk[i] == '\n') ++c.pending;
+        }
+        c.in.append(chunk, size_t(r));
+        got += size_t(r);
+        if (size_t(r) < sizeof(chunk) || got >= kMaxIntake) break;
+      } else if (r == 0) {
+        eof = true;
+        break;
+      } else if (errno == EINTR) {
+        continue;
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        break;
+      } else {
+        return false;
+      }
+    }
+    // EOF with no complete frame buffered: plain close (a trailing
+    // partial line was never answerable).
+    if (eof && c.in.find('\n') == std::string::npos) return false;
+    // In-flight budget: commands buffered-but-unanswered on this
+    // connection (counted per newline received, decremented per line
+    // dispatched). Exceeding it answers BUSY and closes — the pipelined
+    // loop otherwise happily queues any depth.
+    const size_t maxp = srv_->max_pipeline_.load(std::memory_order_acquire);
+    if (maxp > 0 && c.pending > maxp) {
+      srv_->stats_.pipeline_rejected.fetch_add(1, std::memory_order_relaxed);
+      c.out.lit("ERROR BUSY pipeline retry\r\n");
+      c.closing = true;
+    }
+    if (!drive(c)) return false;
+    if (eof && !c.closing) {
+      // Half-close: the commands that arrived before the FIN were
+      // dispatched above — flush their responses (the peer may have only
+      // shutdown its write side), then close.
+      c.closing = true;
+      return drive(c);
+    }
+    return true;
+  }
+
+  // The connection's state machine: parse + dispatch buffered frames,
+  // flush the coalesced responses, manage interest + backpressure.
+  // Returns false when the connection is finished (caller closes it).
+  bool drive(Conn& c) {
+    for (;;) {
+      process_lines(c);
+      if (c.closing && !c.in.empty()) {
+        // Nothing past a closing point is ever parsed: free the input
+        // carry instead of letting a flooding client grow it while the
+        // close waits out a blocked flush.
+        c.in.clear();
+        c.in.shrink_to_fit();
+        c.pending = 0;
+      }
+      FlushResult fr = flush(c);
+      if (fr == FlushResult::kError) return false;
+      if (fr == FlushResult::kBlocked) {
+        // Backpressure hysteresis while the socket is full: stop READING
+        // past kOutHigh, resume below kOutLow, hold state in between. A
+        // closing connection never reads again.
+        const bool pause = c.closing           ? true
+                           : c.out.bytes > kOutHigh ? true
+                           : c.out.bytes < kOutLow  ? false
+                                                    : c.read_paused;
+        bool changed = false;
+        if (!c.want_write) {
+          c.want_write = true;
+          changed = true;
+        }
+        if (pause != c.read_paused) {
+          c.read_paused = pause;
+          changed = true;
+        }
+        if (changed) update_interest(c);
+        return true;
+      }
+      // Fully flushed.
+      if (c.closing) {
+        if (c.shutdown_req) {
+          if (srv_->opts_.exit_on_shutdown) {
+            // Reference parity: SHUTDOWN exits the process
+            // (server.rs:909-923) — after the OK has been flushed.
+            std::exit(0);
+          }
+          srv_->stop();
+        }
+        return false;
+      }
+      bool changed = false;
+      if (c.want_write) {
+        c.want_write = false;
+        changed = true;
+      }
+      if (c.read_paused) {
+        c.read_paused = false;
+        changed = true;
+      }
+      if (changed) update_interest(c);
+      // More complete frames still buffered (compat mode processes one
+      // per pass; backpressure may have paused mid-buffer): keep going.
+      if (c.in.find('\n') == std::string::npos) return true;
+    }
+  }
+
+  // Parse and dispatch every complete line currently buffered, appending
+  // responses (in request order) to the out queue. Stops early on
+  // backpressure, close, or — compat mode — after one command.
+  void process_lines(Conn& c) {
+    size_t pos = 0;
+    const bool pipelined = srv_->opts_.pipelined;
+    while (!c.closing && c.out.bytes <= kOutHigh) {
+      size_t nl = c.in.find('\n', pos);
+      if (nl == std::string::npos) break;
+      std::string line = c.in.substr(pos, nl + 1 - pos);
+      pos = nl + 1;
+      if (c.pending > 0) --c.pending;
+      if (line.size() > srv_->opts_.max_line) {
+        c.out.lit("ERROR line too long\r\n");
+        c.closing = true;
+        break;
+      }
+      bool close_conn = false;
+      srv_->run_command(line, c.meta, c.out, &close_conn);
+      ws_.commands.fetch_add(1, std::memory_order_relaxed);
+      if (close_conn) {
+        c.closing = true;
+        c.shutdown_req = true;
+        break;
+      }
+      if (!pipelined) break;  // compat: one response per flush/syscall
+    }
+    if (pos > 0) c.in.erase(0, pos);
+    // Unterminated input past the line cap: same answer as an oversized
+    // complete line (the residue here never contains a newline).
+    if (!c.closing && c.in.size() > srv_->opts_.max_line &&
+        c.in.find('\n') == std::string::npos) {
+      c.out.lit("ERROR line too long\r\n");
+      c.closing = true;
+    }
+  }
+
+  // Flush the out queue: one sendmsg (writev) over up to kMaxIov pending
+  // segments per syscall, until drained or the socket blocks.
+  FlushResult flush(Conn& c) {
+    while (c.out.bytes > 0) {
+      iovec iov[kMaxIov];
+      size_t n = 0;
+      size_t off = c.out.head_off;
+      for (size_t i = c.out.head; i < c.out.segs.size() && n < kMaxIov; ++i) {
+        const std::string& s = c.out.segs[i];
+        if (off >= s.size()) {
+          off = 0;
+          continue;
+        }
+        iov[n].iov_base = const_cast<char*>(s.data()) + off;
+        iov[n].iov_len = s.size() - off;
+        ++n;
+        off = 0;
+      }
+      if (n == 0) break;
+      msghdr mh{};
+      mh.msg_iov = iov;
+      mh.msg_iovlen = n;
+      ssize_t w = ::sendmsg(c.fd, &mh, MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          return FlushResult::kBlocked;
+        }
+        return FlushResult::kError;
+      }
+      ws_.writev_calls.fetch_add(1, std::memory_order_relaxed);
+      ws_.writev_bytes.fetch_add(uint64_t(w), std::memory_order_relaxed);
+      size_t rem = size_t(w);
+      c.out.bytes -= rem;
+      while (rem > 0) {
+        std::string& s = c.out.segs[c.out.head];
+        const size_t avail = s.size() - c.out.head_off;
+        if (rem >= avail) {
+          rem -= avail;
+          ++c.out.head;
+          c.out.head_off = 0;
+        } else {
+          c.out.head_off += rem;
+          rem = 0;
+        }
+      }
+    }
+    c.out.reset();
+    return FlushResult::kDone;
+  }
+
+  Server* srv_;
+  IoWorkerStats& ws_;
+  int epfd_ = -1;
+  int wake_r_ = -1;
+  int wake_w_ = -1;
+  std::thread th_;
+  std::mutex inbox_mu_;
+  std::vector<Pending> inbox_;
+  std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+};
+
+// --------------------------------------------------------------- Server
+
 Server::Server(Engine* engine, ServerOptions opts)
     : engine_(engine), opts_(std::move(opts)) {}
 
@@ -119,6 +545,31 @@ bool Server::start() {
   socklen_t blen = sizeof(bound);
   ::getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
   bound_port_ = ntohs(bound.sin_port);
+
+  // The worker pool, sized once: hardware concurrency unless configured.
+  size_t n = opts_.io_threads;
+  if (n == 0) {
+    n = std::thread::hardware_concurrency();
+    if (n == 0) n = 1;
+  }
+  if (n > 64) n = 64;  // sanity cap; nothing here scales past that
+  worker_stats_.reset(new IoWorkerStats[n]);
+  for (size_t i = 0; i < n; ++i) {
+    auto w = std::make_unique<IoWorker>(this, i);
+    if (!w->start()) {
+      stop_.store(true, std::memory_order_release);
+      for (auto& live : workers_) live->wake();
+      workers_.clear();  // ~IoWorker joins + releases
+      stop_.store(false, std::memory_order_release);
+      worker_stats_.reset();
+      ::close(fd);
+      return false;
+    }
+    workers_.push_back(std::move(w));
+  }
+  workers_live_ = n;
+  started_ = true;
+
   listen_fd_.store(fd, std::memory_order_release);
   accept_thread_ = std::thread([this] { accept_loop(); });
   tree_reaper_ = std::thread([this] { tree_reaper_loop(); });
@@ -153,11 +604,14 @@ void Server::stop() {
     int fd = listen_fd_.load(std::memory_order_acquire);
     if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
   }
-  std::lock_guard lk(clients_mu_);
-  for (auto& [id, meta] : clients_) {
-    (void)id;
-    ::shutdown(meta->fd, SHUT_RDWR);
+  {
+    std::lock_guard lk(clients_mu_);
+    for (auto& [id, meta] : clients_) {
+      (void)id;
+      ::shutdown(meta->fd, SHUT_RDWR);
+    }
   }
+  for (auto& w : workers_) w->wake();
 }
 
 void Server::wait() {
@@ -168,10 +622,12 @@ void Server::wait() {
     int fd = listen_fd_.exchange(-1, std::memory_order_acq_rel);
     if (fd >= 0) ::close(fd);
   }
-  // Handler threads are detached; spin briefly until they all unregister.
-  while (live_handlers_.load(std::memory_order_acquire) > 0) {
-    ::usleep(1000);
-  }
+  // Join EVERY worker loop before releasing ANY fd: a worker running
+  // stop() (SHUTDOWN verb) pokes sibling wake pipes, so those fds must
+  // outlive all worker threads. The accept thread has already exited, so
+  // no new submissions can arrive either.
+  for (auto& w : workers_) w->join_thread();
+  for (auto& w : workers_) w->release();
 }
 
 void Server::set_cluster_callback(ClusterCallback cb) {
@@ -198,11 +654,11 @@ void Server::accept_loop() {
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 
     // Admission control: past max_connections (or while draining) the
-    // excess accept is answered BUSY and closed RIGHT HERE — no handler
-    // thread, no client registration, no request state. The answer goes
+    // excess accept is answered BUSY and closed RIGHT HERE — it never
+    // enters the worker pool, holds no request state. The answer goes
     // out within one RTT of the connect (the reply rides the accept
     // loop), and established connections never see the flood: their
-    // handler threads already exist.
+    // worker loops keep turning.
     const size_t maxc = max_connections_.load(std::memory_order_acquire);
     const bool draining =
         degradation_.load(std::memory_order_acquire) >=
@@ -231,122 +687,15 @@ void Server::accept_loop() {
       std::lock_guard lk(clients_mu_);
       clients_[meta->id] = meta;
     }
-    // stop() may have run between the stop_ check above and the
-    // registration: it would then have missed this fd when poking clients_,
-    // leaving the handler parked in recv() forever and wait() spinning.
-    // Re-check after registration so one side always sees the other.
-    if (stop_.load(std::memory_order_acquire)) ::shutdown(fd, SHUT_RDWR);
     stats_.total_connections++;
     stats_.active_connections++;
-    live_handlers_.fetch_add(1, std::memory_order_acq_rel);
-    std::thread([this, fd, meta] {
-      bool shutdown_req = handle_connection(fd, meta);
-      {
-        // Deregister before closing so stop() never pokes a recycled fd.
-        std::lock_guard lk(clients_mu_);
-        clients_.erase(meta->id);
-      }
-      ::close(fd);
-      stats_.active_connections--;
-      live_handlers_.fetch_sub(1, std::memory_order_acq_rel);
-      if (shutdown_req) {
-        if (opts_.exit_on_shutdown) {
-          // Reference parity: SHUTDOWN exits the process (server.rs:909-923).
-          std::exit(0);
-        }
-        stop();
-      }
-    }).detach();
-  }
-}
-
-bool Server::handle_connection(int fd, std::shared_ptr<ClientMeta> meta) {
-  std::string buf;
-  char chunk[65536];
-  // In-flight budget: commands buffered-but-unprocessed on this
-  // connection. Incremented per newline received, decremented per line
-  // dispatched; since dispatch is synchronous, in steady state this is
-  // the line count of ONE recv() burst — the budget caps how much
-  // parse/response work a single read can queue, not a cumulative
-  // backlog (none can accumulate: every response is written before the
-  // next recv). Exceeding it answers BUSY and closes.
-  size_t pending = 0;
-  for (;;) {
-    // Extract complete lines already buffered.
-    size_t nl;
-    while ((nl = buf.find('\n')) != std::string::npos) {
-      std::string line = buf.substr(0, nl + 1);
-      buf.erase(0, nl + 1);
-      if (pending > 0) --pending;
-      if (line.size() > opts_.max_line) {
-        send_all(fd, "ERROR line too long\r\n");
-        return false;
-      }
-      auto parsed = parse_command(line);
-      if (!parsed.ok) {
-        if (!send_all(fd, "ERROR " + parsed.error + "\r\n")) return false;
-        continue;
-      }
-      meta->last_cmd_unix.store(unix_now(), std::memory_order_relaxed);
-      stats_.count(parsed.cmd);
-      bool close_conn = false;
-      // Per-command dispatch latency: two steady_clock reads + one relaxed
-      // atomic add per command (~50 ns against a multi-us dispatch) feed
-      // the lock-free histogram behind STATS cmd_latency_us_* — cheap
-      // enough to stay on by default on the SET hot path (bench.py
-      // measures the overhead; set_latency_enabled is the A/B switch).
-      const bool timed = latency_enabled_.load(std::memory_order_acquire);
-      const bool traced = !parsed.cmd.trace.empty();
-      const auto t0 = (timed || traced)
-                          ? std::chrono::steady_clock::now()
-                          : std::chrono::steady_clock::time_point{};
-      // Wall-clock start rides with the TRACESPAN notification so the
-      // collector can place the donor span on the initiator's timeline
-      // (cross-node skew is the usual Dapper caveat, documented).
-      const uint64_t wall0 = traced ? unix_now_ns() : 0;
-      std::string response = dispatch(parsed.cmd, &close_conn);
-      if (timed || traced) {
-        const uint64_t dur_ns = uint64_t(
-            std::chrono::duration_cast<std::chrono::nanoseconds>(
-                std::chrono::steady_clock::now() - t0)
-                .count());
-        if (timed) stats_.latency.observe_ns(dur_ns);
-        if (traced) {
-          // Fire-and-forget span notification to the control plane: only
-          // traced cluster verbs pay this (a handful per sync cycle, never
-          // the GET/SET hot path); the response is ignored — a node
-          // without a cluster plane simply drops the span.
-          ClusterCallback cb;
-          {
-            std::lock_guard lk(cb_mu_);
-            cb = cluster_cb_;
-          }
-          if (cb) {
-            cb(std::string("TRACESPAN ") + traced_verb_name(parsed.cmd.verb) +
-               " " + parsed.cmd.trace + " " + std::to_string(wall0) + " " +
-               std::to_string(dur_ns));
-          }
-        }
-      }
-      if (!send_all(fd, response)) return false;
-      if (close_conn) return true;
-    }
-    if (buf.size() > opts_.max_line) {
-      send_all(fd, "ERROR line too long\r\n");
-      return false;
-    }
-    ssize_t r = ::recv(fd, chunk, sizeof(chunk), 0);
-    if (r <= 0) return false;
-    for (ssize_t i = 0; i < r; ++i) {
-      if (chunk[i] == '\n') ++pending;
-    }
-    const size_t maxp = max_pipeline_.load(std::memory_order_acquire);
-    if (maxp > 0 && pending > maxp) {
-      stats_.pipeline_rejected.fetch_add(1, std::memory_order_relaxed);
-      send_all(fd, "ERROR BUSY pipeline retry\r\n");
-      return false;
-    }
-    buf.append(chunk, size_t(r));
+    // Round-robin handoff: the worker owns the fd from here (stop() after
+    // this point still reaches it — via the clients_ shutdown poke AND the
+    // worker's own stop_-checked inbox/teardown paths).
+    ::fcntl(fd, F_SETFL, ::fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    const size_t w =
+        next_worker_.fetch_add(1, std::memory_order_relaxed) % workers_live_;
+    workers_[w]->submit(fd, std::move(meta));
   }
 }
 
@@ -354,11 +703,12 @@ std::string Server::stats_text() {
   // One body for the STATS verb AND the C-API bridge (mkv_server_stats ->
   // /metrics): the reference-parity counter block, then the extension
   // lines — engine tombstone evictions, event-queue depth/drops (the
-  // replication feed's backlog), and the overload plane (degradation
-  // level + shed counters). All integer-valued `name:value` text, so the
-  // exporter bridges every line without special cases.
+  // replication feed's backlog), the overload plane (degradation level +
+  // shed counters), and the io plane (pool shape + per-worker loop
+  // counters). All integer-valued `name:value` text, so the exporter
+  // bridges every line without special cases.
   std::string out = stats_.format_stats();
-  auto add = [&](const char* name, unsigned long long v) {
+  auto add = [&](const std::string& name, unsigned long long v) {
     out += name;
     out += ":";
     out += std::to_string(v);
@@ -375,6 +725,20 @@ std::string Server::stats_text() {
   add("pipeline_rejected", ld(stats_.pipeline_rejected));
   add("shed_commands", ld(stats_.shed_commands));
   add("readonly_commands", ld(stats_.readonly_commands));
+  // io plane: pool shape + per-worker counters (loop depth = commands /
+  // wakeups; mean flush size = writev_bytes / writev_calls). Per-worker
+  // lines let the top dashboard and /metrics see imbalance, not just sums.
+  add("io_threads", workers_live_);
+  add("io_pipelined", opts_.pipelined ? 1 : 0);
+  for (size_t i = 0; i < workers_live_; ++i) {
+    const IoWorkerStats& ws = worker_stats_[i];
+    const std::string p = "io_worker_" + std::to_string(i) + "_";
+    add(p + "connections", ld(ws.connections));
+    add(p + "commands", ld(ws.commands));
+    add(p + "wakeups", ld(ws.wakeups));
+    add(p + "writev_calls", ld(ws.writev_calls));
+    add(p + "writev_bytes", ld(ws.writev_bytes));
+  }
   return out;
 }
 
@@ -389,7 +753,59 @@ void Server::stage_event(ChangeOp op, const std::string& key,
   }
 }
 
-std::string Server::dispatch(const Command& cmd, bool* close_conn) {
+void Server::run_command(const std::string& line,
+                         const std::shared_ptr<ClientMeta>& meta,
+                         OutQueue& out, bool* close_conn) {
+  auto parsed = parse_command(line);
+  if (!parsed.ok) {
+    out.lit("ERROR ");
+    out.lit(parsed.error);
+    out.lit("\r\n");
+    return;
+  }
+  meta->last_cmd_unix.store(unix_now(), std::memory_order_relaxed);
+  stats_.count(parsed.cmd);
+  // Per-command dispatch latency: two steady_clock reads + one relaxed
+  // atomic add per command (~50 ns against a multi-us dispatch) feed
+  // the lock-free histogram behind STATS cmd_latency_us_* — cheap
+  // enough to stay on by default on the SET hot path (bench.py
+  // measures the overhead; set_latency_enabled is the A/B switch).
+  const bool timed = latency_enabled_.load(std::memory_order_acquire);
+  const bool traced = !parsed.cmd.trace.empty();
+  const auto t0 = (timed || traced)
+                      ? std::chrono::steady_clock::now()
+                      : std::chrono::steady_clock::time_point{};
+  // Wall-clock start rides with the TRACESPAN notification so the
+  // collector can place the donor span on the initiator's timeline
+  // (cross-node skew is the usual Dapper caveat, documented).
+  const uint64_t wall0 = traced ? unix_now_ns() : 0;
+  dispatch(parsed.cmd, out, close_conn);
+  if (timed || traced) {
+    const uint64_t dur_ns = uint64_t(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0)
+            .count());
+    if (timed) stats_.latency.observe_ns(dur_ns);
+    if (traced) {
+      // Fire-and-forget span notification to the control plane: only
+      // traced cluster verbs pay this (a handful per sync cycle, never
+      // the GET/SET hot path); the response is ignored — a node
+      // without a cluster plane simply drops the span.
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        cb(std::string("TRACESPAN ") + traced_verb_name(parsed.cmd.verb) +
+           " " + parsed.cmd.trace + " " + std::to_string(wall0) + " " +
+           std::to_string(dur_ns));
+      }
+    }
+  }
+}
+
+void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
   // Degradation ladder: shedding answers writes with a RETRYABLE BUSY
   // (memory/disk pressure is transient — clients back off and retry);
   // read_only/draining answer READONLY (not retryable until the node
@@ -401,10 +817,16 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         degrade_reason_text(degrade_reason_.load(std::memory_order_acquire));
     if (deg == int(Degradation::kShedding)) {
       stats_.shed_commands.fetch_add(1, std::memory_order_relaxed);
-      return std::string("ERROR BUSY ") + why + " retry\r\n";
+      out.lit("ERROR BUSY ");
+      out.lit(why);
+      out.lit(" retry\r\n");
+      return;
     }
     stats_.readonly_commands.fetch_add(1, std::memory_order_relaxed);
-    return std::string("ERROR READONLY ") + why + "\r\n";
+    out.lit("ERROR READONLY ");
+    out.lit(why);
+    out.lit("\r\n");
+    return;
   }
   if (!serving_.load(std::memory_order_acquire)) {
     // Bootstrap gate: no read serves before the shipped snapshot's stamped
@@ -424,65 +846,99 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       case Verb::TreeLevel:
       case Verb::SnapMeta:
       case Verb::SnapChunk:
-        return "ERROR LOADING bootstrap in progress\r\n";
+        out.lit("ERROR LOADING bootstrap in progress\r\n");
+        return;
       default:
         break;
     }
   }
   switch (cmd.verb) {
     case Verb::Get: {
+      // The hot path: ONE copy of the value (out of the engine, under the
+      // shard lock), moved into the out queue — big values become their
+      // own iovec segment and are never copied again.
       auto v = engine_->get(cmd.key);
-      return v ? "VALUE " + *v + "\r\n" : "NOT_FOUND\r\n";
+      if (!v) {
+        out.lit("NOT_FOUND\r\n");
+        return;
+      }
+      out.lit("VALUE ");
+      out.payload(std::move(*v));
+      out.lit("\r\n");
+      return;
     }
     case Verb::Ping:
-      return "PONG " + cmd.message + "\r\n";
+      out.lit("PONG ");
+      out.lit(cmd.message);
+      out.lit("\r\n");
+      return;
     case Verb::Echo:
-      return "ECHO " + cmd.message + "\r\n";
+      out.lit("ECHO ");
+      out.lit(cmd.message);
+      out.lit("\r\n");
+      return;
     case Verb::Dbsize:
-      return "DBSIZE " + std::to_string(engine_->dbsize()) + "\r\n";
+      out.lit("DBSIZE " + std::to_string(engine_->dbsize()) + "\r\n");
+      return;
     case Verb::Exists: {
       size_t count = 0;
       for (const auto& k : cmd.keys) {
         if (engine_->exists(k)) ++count;
       }
-      return "EXISTS " + std::to_string(count) + "\r\n";
+      out.lit("EXISTS " + std::to_string(count) + "\r\n");
+      return;
     }
     case Verb::Scan: {
       auto keys = engine_->scan(cmd.prefix);
-      std::string out = "KEYS " + std::to_string(keys.size()) + "\r\n";
-      for (const auto& k : keys) out += k + "\r\n";
-      return out;
+      std::string body = "KEYS " + std::to_string(keys.size()) + "\r\n";
+      for (const auto& k : keys) {
+        body += k;
+        body += "\r\n";
+      }
+      out.payload(std::move(body));
+      return;
     }
     case Verb::Set: {
       std::lock_guard lk(write_stripe(cmd.key));
-      if (!engine_->set(cmd.key, cmd.value)) return "ERROR set failed\r\n";
+      if (!engine_->set(cmd.key, cmd.value)) {
+        out.lit("ERROR set failed\r\n");
+        return;
+      }
       stage_event(ChangeOp::Set, cmd.key, cmd.value, true);
-      return "OK\r\n";
+      out.lit("OK\r\n");
+      return;
     }
     case Verb::Delete: {
       std::lock_guard lk(write_stripe(cmd.key));
       if (engine_->del(cmd.key)) {
         stage_event(ChangeOp::Del, cmd.key, "", false);
-        return "DELETED\r\n";
+        out.lit("DELETED\r\n");
+        return;
       }
-      return "NOT_FOUND\r\n";
+      out.lit("NOT_FOUND\r\n");
+      return;
     }
     case Verb::Memory:
-      return "MEMORY " + std::to_string(engine_->memory_usage()) + "\r\n";
+      out.lit("MEMORY " + std::to_string(engine_->memory_usage()) + "\r\n");
+      return;
     case Verb::ClientList: {
-      std::string out = "CLIENT LIST\r\n";
+      std::string body = "CLIENT LIST\r\n";
       uint64_t now = unix_now();
-      std::lock_guard lk(clients_mu_);
-      for (const auto& [id, c] : clients_) {
-        uint64_t last = c->last_cmd_unix.load(std::memory_order_relaxed);
-        uint64_t age = now >= c->connected_unix ? now - c->connected_unix : 0;
-        uint64_t idle = now >= last ? now - last : 0;
-        out += "id=" + std::to_string(c->id) + " addr=" + c->addr +
-               " age=" + std::to_string(age) + " idle=" + std::to_string(idle) +
-               "\r\n";
+      {
+        std::lock_guard lk(clients_mu_);
+        for (const auto& [id, c] : clients_) {
+          uint64_t last = c->last_cmd_unix.load(std::memory_order_relaxed);
+          uint64_t age =
+              now >= c->connected_unix ? now - c->connected_unix : 0;
+          uint64_t idle = now >= last ? now - last : 0;
+          body += "id=" + std::to_string(c->id) + " addr=" + c->addr +
+                  " age=" + std::to_string(age) +
+                  " idle=" + std::to_string(idle) + "\r\n";
+        }
       }
-      out += "END\r\n";
-      return out;
+      body += "END\r\n";
+      out.payload(std::move(body));
+      return;
     }
     case Verb::Peers: {
       // Per-peer health from the control plane's failure detector
@@ -494,9 +950,13 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       }
       if (cb) {
         std::string resp = cb("PEERS");
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
-      return "PEERS 0\r\nEND\r\n";
+      out.lit("PEERS 0\r\nEND\r\n");
+      return;
     }
     case Verb::Metrics: {
       // Control-plane counter snapshot (extension verb): transport
@@ -509,9 +969,13 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       }
       if (cb) {
         std::string resp = cb("METRICS");
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
-      return "METRICS\r\nEND\r\n";
+      out.lit("METRICS\r\nEND\r\n");
+      return;
     }
     case Verb::Trace: {
       // Correlated anti-entropy cycle traces from the control plane's ring
@@ -524,9 +988,13 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       if (cb) {
         std::string resp =
             cb("TRACE " + std::to_string(cmd.amount.value_or(8)));
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
-      return "TRACES 0\r\nEND\r\n";
+      out.lit("TRACES 0\r\nEND\r\n");
+      return;
     }
     case Verb::TraceDump: {
       // Raw causal-trace spans from the control plane's collector (the
@@ -540,9 +1008,13 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       if (cb) {
         std::string resp =
             cb("TRACEDUMP " + std::to_string(cmd.amount.value_or(0)));
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
-      return "SPANS 0\r\nEND\r\n";
+      out.lit("SPANS 0\r\nEND\r\n");
+      return;
     }
     case Verb::Profile: {
       // Bounded device-profiler capture; only the control plane owns a jax
@@ -555,9 +1027,13 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       if (cb) {
         std::string resp =
             cb("PROFILE " + std::to_string(cmd.amount.value_or(1)));
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
-      return "ERROR device profiler unavailable\r\n";
+      out.lit("ERROR device profiler unavailable\r\n");
+      return;
     }
     case Verb::SnapMeta:
     case Verb::SnapChunk: {
@@ -579,9 +1055,13 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
                       std::to_string(cmd.snap_off) + " " +
                       std::to_string(cmd.snap_cnt);
         std::string resp = cb(line);
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
-      return "ERROR snapshot shipping unavailable\r\n";
+      out.lit("ERROR snapshot shipping unavailable\r\n");
+      return;
     }
     case Verb::Sync:
     case Verb::Replicate: {
@@ -604,17 +1084,23 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
                                                            : "status";
         }
         std::string resp = cb(line);
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
       if (cmd.verb == Verb::Replicate &&
           cmd.action == ReplicateAction::Status) {
-        return "REPLICATION disabled\r\n";
+        out.lit("REPLICATION disabled\r\n");
+        return;
       }
       if (cmd.verb == Verb::Replicate &&
           cmd.action == ReplicateAction::Disable) {
-        return "OK\r\n";
+        out.lit("OK\r\n");
+        return;
       }
-      return "ERROR replication not configured\r\n";
+      out.lit("ERROR replication not configured\r\n");
+      return;
     }
     case Verb::Hash: {
       // Pattern semantics (server.rs:647-658): absent or "*" = all keys;
@@ -633,7 +1119,10 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         }
         if (cb) {
           std::string resp = cb("HASH");
-          if (!resp.empty()) return resp;
+          if (!resp.empty()) {
+            out.payload(std::move(resp));
+            return;
+          }
         }
       }
       auto keys = engine_->scan(prefix);
@@ -646,58 +1135,98 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
       std::string hex = merkle_root(std::move(items), root)
                             ? digest_hex(root)
                             : std::string(64, '0');
-      if (pat.empty()) return "HASH " + hex + "\r\n";
-      return "HASH " + pat + " " + hex + "\r\n";
+      if (pat.empty()) {
+        out.lit("HASH " + hex + "\r\n");
+      } else {
+        out.lit("HASH " + pat + " " + hex + "\r\n");
+      }
+      return;
     }
     case Verb::Increment:
     case Verb::Decrement: {
       int64_t amount = cmd.amount.value_or(1);
       std::lock_guard lk(write_stripe(cmd.key));
-      auto r = cmd.verb == Verb::Increment ? engine_->increment(cmd.key, amount)
-                                           : engine_->decrement(cmd.key, amount);
-      if (!r.ok) return "ERROR " + r.error + "\r\n";
+      auto r = cmd.verb == Verb::Increment
+                   ? engine_->increment(cmd.key, amount)
+                   : engine_->decrement(cmd.key, amount);
+      if (!r.ok) {
+        out.lit("ERROR " + r.error + "\r\n");
+        return;
+      }
       stage_event(
           cmd.verb == Verb::Increment ? ChangeOp::Incr : ChangeOp::Decr,
           cmd.key, std::to_string(r.value), true);
-      return "VALUE " + std::to_string(r.value) + "\r\n";
+      out.lit("VALUE " + std::to_string(r.value) + "\r\n");
+      return;
     }
     case Verb::Append:
     case Verb::Prepend: {
       // Empty value: report current value, never mutate (server.rs:772-779).
       if (cmd.value.empty()) {
         auto v = engine_->get(cmd.key);
-        return v ? "VALUE " + *v + "\r\n" : "ERROR Key not found\r\n";
+        if (v) {
+          out.lit("VALUE ");
+          out.payload(std::move(*v));
+          out.lit("\r\n");
+        } else {
+          out.lit("ERROR Key not found\r\n");
+        }
+        return;
       }
       std::lock_guard lk(write_stripe(cmd.key));
       auto r = cmd.verb == Verb::Append ? engine_->append(cmd.key, cmd.value)
                                         : engine_->prepend(cmd.key, cmd.value);
-      if (!r.ok) return "ERROR " + r.error + "\r\n";
+      if (!r.ok) {
+        out.lit("ERROR " + r.error + "\r\n");
+        return;
+      }
       stage_event(
           cmd.verb == Verb::Append ? ChangeOp::Append : ChangeOp::Prepend,
           cmd.key, r.value, true);
-      return "VALUE " + r.value + "\r\n";
+      out.lit("VALUE ");
+      out.payload(std::move(r.value));
+      out.lit("\r\n");
+      return;
     }
     case Verb::MultiGet: {
-      std::string body;
+      // Two passes: the found count must ride in the header BEFORE any
+      // value. Values are read once and MOVED into the out queue (their
+      // own iovec segments past the inline threshold).
+      std::vector<std::optional<std::string>> vals;
+      vals.reserve(cmd.keys.size());
       size_t found = 0;
       for (const auto& k : cmd.keys) {
-        if (auto v = engine_->get(k)) {
-          body += k + " " + *v + "\r\n";
-          ++found;
+        vals.push_back(engine_->get(k));
+        if (vals.back()) ++found;
+      }
+      if (found == 0) {
+        out.lit("NOT_FOUND\r\n");
+        return;
+      }
+      out.lit("VALUES " + std::to_string(found) + "\r\n");
+      for (size_t i = 0; i < cmd.keys.size(); ++i) {
+        out.lit(cmd.keys[i]);
+        if (vals[i]) {
+          out.lit(" ");
+          out.payload(std::move(*vals[i]));
+          out.lit("\r\n");
         } else {
-          body += k + " NOT_FOUND\r\n";
+          out.lit(" NOT_FOUND\r\n");
         }
       }
-      if (found == 0) return "NOT_FOUND\r\n";
-      return "VALUES " + std::to_string(found) + "\r\n" + body;
+      return;
     }
     case Verb::MultiSet: {
       for (const auto& [k, v] : cmd.pairs) {
         std::lock_guard lk(write_stripe(k));
-        if (!engine_->set(k, v)) return "ERROR set failed\r\n";
+        if (!engine_->set(k, v)) {
+          out.lit("ERROR set failed\r\n");
+          return;
+        }
         stage_event(ChangeOp::Set, k, v, true);
       }
-      return "OK\r\n";
+      out.lit("OK\r\n");
+      return;
     }
     case Verb::LeafHashes: {
       auto keys = engine_->scan(cmd.prefix);
@@ -728,7 +1257,9 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         body += k + " - " + std::to_string(ts) + "\r\n";
         ++listed;
       }
-      return "HASHES " + std::to_string(listed) + "\r\n" + body;
+      out.lit("HASHES " + std::to_string(listed) + "\r\n");
+      out.payload(std::move(body));
+      return;
     }
     case Verb::HashPage: {
       // Cursor-paged LEAFHASHES: up to `count` merged (live + tombstone)
@@ -778,7 +1309,9 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
           ++listed;
         }
       }
-      return "HASHES " + std::to_string(listed) + "\r\n" + body;
+      out.lit("HASHES " + std::to_string(listed) + "\r\n");
+      out.payload(std::move(body));
+      return;
     }
     case Verb::TreeLevel: {
       // Subtree-bisection anti-entropy: digests [lo, hi) of reference-tree
@@ -798,7 +1331,10 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
         std::string resp = cb("TREELEVEL " + std::to_string(cmd.level) +
                               " " + std::to_string(cmd.lo) + " " +
                               std::to_string(cmd.hi));
-        if (!resp.empty()) return resp;
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
       }
       std::lock_guard lk(tree_mu_);
       // Version read BEFORE the snapshot: a write landing in between makes
@@ -837,36 +1373,48 @@ std::string Server::dispatch(const Command& cmd, bool* close_conn) {
           ++count;
         }
       }
-      return "NODES " + std::to_string(count) + " " + std::to_string(n) +
-             "\r\n" + body;
+      out.lit("NODES " + std::to_string(count) + " " + std::to_string(n) +
+              "\r\n");
+      out.payload(std::move(body));
+      return;
     }
     case Verb::Truncate:
     case Verb::Flushdb: {
       // FLUSHDB truncates, like the reference (server.rs:901-908).
-      if (!engine_->truncate()) return "ERROR truncate failed\r\n";
+      if (!engine_->truncate()) {
+        out.lit("ERROR truncate failed\r\n");
+        return;
+      }
       stage_event(ChangeOp::Truncate, "", "", false);
-      return "OK\r\n";
+      out.lit("OK\r\n");
+      return;
     }
     case Verb::Stats:
-      return "STATS\r\n" + stats_text() + "END\r\n";
+      out.lit("STATS\r\n");
+      out.payload(stats_text());
+      out.lit("END\r\n");
+      return;
     case Verb::Info: {
-      std::string out = "INFO\r\n";
-      out += "version:" + opts_.version + "\r\n";
-      out += "uptime_seconds:" + std::to_string(stats_.uptime_seconds()) +
-             "\r\n";
-      out += "uptime:" + stats_.uptime_human() + "\r\n";
-      out += "server_time_unix:" + std::to_string(unix_now()) + "\r\n";
-      out += "db_keys:" + std::to_string(engine_->dbsize()) + "\r\n";
-      out += "END\r\n";
-      return out;
+      std::string body = "INFO\r\n";
+      body += "version:" + opts_.version + "\r\n";
+      body += "uptime_seconds:" + std::to_string(stats_.uptime_seconds()) +
+              "\r\n";
+      body += "uptime:" + stats_.uptime_human() + "\r\n";
+      body += "server_time_unix:" + std::to_string(unix_now()) + "\r\n";
+      body += "db_keys:" + std::to_string(engine_->dbsize()) + "\r\n";
+      body += "END\r\n";
+      out.payload(std::move(body));
+      return;
     }
     case Verb::Version:
-      return "VERSION " + opts_.version + "\r\n";
+      out.lit("VERSION " + opts_.version + "\r\n");
+      return;
     case Verb::Shutdown:
       *close_conn = true;
-      return "OK\r\n";
+      out.lit("OK\r\n");
+      return;
   }
-  return "ERROR internal\r\n";
+  out.lit("ERROR internal\r\n");
 }
 
 }  // namespace mkv

@@ -1,10 +1,27 @@
-// Native TCP server: CRLF text protocol over task-per-connection threads.
+// Native TCP server: CRLF text protocol over an epoll event-loop worker
+// pool (memcached-class I/O plane).
 //
 // Equivalent of the reference's tokio server (/root/reference/src/server.rs:
-// 376-958): accept loop, one handler per connection, 1 MiB line cap, stats,
-// client table, and post-write event publication. Differences by design:
-//   - engine calls go straight to the SHARDED engine — there is no global
-//     store mutex like server.rs:386;
+// 376-958): accept loop, stats, client table, and post-write event
+// publication. Differences by design:
+//   - I/O runs on a FIXED pool of epoll workers ([server] io_threads,
+//     default = hardware concurrency) instead of one thread per
+//     connection: accepted fds are distributed round-robin and each
+//     connection is owned by exactly ONE worker for its whole life, so
+//     per-connection state (input carry, output queue, interest flags)
+//     is touched by a single thread and needs no lock;
+//   - requests PIPELINE: every readable event drains the socket, parses
+//     ALL complete frames in the buffer (partial frames carry across
+//     reads), dispatches them in order, and flushes the responses with
+//     one writev per burst (see OutQueue) — per-command syscalls are
+//     gone from the hot path;
+//   - a slow reader cannot stall its worker: writes that hit EAGAIN park
+//     the rest of the queue behind EPOLLOUT interest, and a connection
+//     whose output backlog passes the high watermark stops being READ
+//     until the backlog drains (backpressure instead of unbounded RAM);
+//   - engine calls go straight to the SHARDED engine — workers dispatch
+//     in parallel against the per-shard locks; there is no global store
+//     mutex like server.rs:386;
 //   - successful writes stage ChangeRecords in an EventQueue the control
 //     plane drains (instead of awaiting an in-process MQTT client);
 //   - SYNC / REPLICATE are delegated to a registered cluster callback (the
@@ -22,6 +39,7 @@
 #include <memory>
 #include <mutex>
 #include <string>
+#include <string_view>
 #include <thread>
 #include <vector>
 
@@ -50,6 +68,73 @@ struct ServerOptions {
   std::string version = "0.1.0";
   bool exit_on_shutdown = false;
   size_t max_line = 1024 * 1024;
+  // Epoll worker-pool width. 0 = hardware concurrency; 1 keeps a single
+  // event loop (still pipelined unless `pipelined` is off).
+  size_t io_threads = 0;
+  // Compat A/B switch for the bench: false restores the pre-pool response
+  // discipline — one write syscall per command response, no coalescing —
+  // so `io_threads=1, pipelined=false` approximates the old
+  // thread-per-connection blocking loop from the server side.
+  bool pipelined = true;
+};
+
+// Per-connection response staging, flushed with one writev (sendmsg) per
+// burst. Protocol literals coalesce into the open tail segment; served
+// values larger than kInlinePayload ride as their OWN (moved) segments —
+// a value is copied exactly once (out of the engine, under the shard
+// lock, which is what makes its lifetime safe once the lock drops) and
+// then never copied again on its way to the socket: the segment string
+// IS the iovec the kernel reads.
+struct OutQueue {
+  // Below this, memcpy into the coalesced literal beats the extra iovec
+  // entry + allocator churn of a dedicated segment.
+  static constexpr size_t kInlinePayload = 512;
+
+  std::vector<std::string> segs;
+  size_t head = 0;      // first segment with unwritten bytes
+  size_t head_off = 0;  // bytes of segs[head] already written
+  size_t bytes = 0;     // unwritten bytes across all segments
+  bool tail_open = false;  // segs.back() is a literal accepting appends
+
+  void lit(std::string_view s) {
+    if (s.empty()) return;
+    if (!tail_open) {
+      segs.emplace_back();
+      tail_open = true;
+    }
+    segs.back().append(s.data(), s.size());
+    bytes += s.size();
+  }
+  // Computed response body or served value: moved, not re-copied, when it
+  // is big enough for the extra segment to pay for itself.
+  void payload(std::string&& v) {
+    if (v.size() <= kInlinePayload) {
+      lit(v);
+      return;
+    }
+    bytes += v.size();
+    segs.push_back(std::move(v));
+    tail_open = false;
+  }
+  bool empty() const { return bytes == 0; }
+  void reset() {
+    segs.clear();
+    head = 0;
+    head_off = 0;
+    bytes = 0;
+    tail_open = false;
+  }
+};
+
+// Per-worker loop counters (STATS io_worker_<i>_* lines; bridged to
+// /metrics as labeled mkv_native_io_worker_* families). Loop depth =
+// commands/wakeups; mean flush size = writev_bytes/writev_calls.
+struct IoWorkerStats {
+  std::atomic<uint64_t> connections{0};   // currently owned
+  std::atomic<uint64_t> commands{0};      // dispatched, lifetime
+  std::atomic<uint64_t> wakeups{0};       // epoll_wait returns with events
+  std::atomic<uint64_t> writev_calls{0};  // flush syscalls
+  std::atomic<uint64_t> writev_bytes{0};  // bytes those syscalls moved
 };
 
 // Node-wide degradation ladder (overload protection): each rung sheds a
@@ -81,25 +166,39 @@ enum class DegradeReason : int {
   kAdmin = 4,
 };
 
+class IoWorker;
+
 class Server {
  public:
   Server(Engine* engine, ServerOptions opts);
   ~Server();
 
-  // Bind + listen + spawn the accept thread. Returns false on bind failure.
+  // Bind + listen + spawn accept thread and the io worker pool. Returns
+  // false on bind failure.
   bool start();
   // Actual bound port (after start(), useful with port 0).
   uint16_t port() const { return bound_port_; }
-  // Request stop: closes the listener and all client sockets.
+  // Request stop: closes the listener, wakes every worker, and shuts down
+  // all client sockets. Never joins — callable from a worker thread
+  // (SHUTDOWN verb) as well as from outside.
   void stop();
   // True once stop was requested (by stop() or a SHUTDOWN command).
   bool stopping() const { return stop_.load(std::memory_order_acquire); }
-  // Block until the accept loop has exited.
+  // Block until the accept loop and every io worker have exited.
   void wait();
 
   void set_cluster_callback(ClusterCallback cb);
   EventQueue& events() { return events_; }
   ServerStats& stats() { return stats_; }
+  // I/O-plane shape; fixed once start() ran (workers cannot be resized
+  // under live connections).
+  void configure_io(size_t io_threads, bool pipelined) {
+    if (started_) return;
+    opts_.io_threads = io_threads;
+    opts_.pipelined = pipelined;
+  }
+  size_t io_threads() const { return workers_live_; }
+  bool pipelined() const { return opts_.pipelined; }
   // Change-event staging is opt-in: without a drainer (standalone binary,
   // replication disabled) staging would pin up to capacity keys+values.
   void set_events_enabled(bool on) {
@@ -129,15 +228,13 @@ class Server {
 
   // Admission-control limits (overload protection). max_connections 0 =
   // unlimited: past it, accepted sockets are answered "ERROR BUSY
-  // connections" and closed without spawning a handler thread — a
-  // connection flood can exhaust neither threads nor request state.
+  // connections" and closed without entering the worker pool — a
+  // connection flood can exhaust neither fds nor request state.
   // max_pipeline bounds one connection's commands BUFFERED-BUT-
-  // UNPROCESSED at once (dispatch is synchronous, so this is the only
-  // backlog that can exist): exceeding it answers BUSY and closes.
-  // Coarse by design — one recv() of tiny commands can carry thousands
-  // of lines, so set it ABOVE the deepest pipeline well-behaved clients
-  // use (or leave 0 = unlimited; the 1 MiB line buffer already bounds
-  // bytes).
+  // UNANSWERED at once: exceeding it answers BUSY and closes. Coarse by
+  // design — one recv() of tiny commands can carry thousands of lines,
+  // so set it ABOVE the deepest pipeline well-behaved clients use (or
+  // leave 0 = unlimited; the 1 MiB line buffer already bounds bytes).
   void set_limits(size_t max_connections, size_t max_pipeline) {
     max_connections_.store(max_connections, std::memory_order_release);
     max_pipeline_.store(max_pipeline, std::memory_order_release);
@@ -154,14 +251,22 @@ class Server {
   // STATS body shared by the wire verb and the C API bridge: the counter
   // block plus the server-scope extension lines (event-queue depth/drops,
   // engine tombstone evictions, the degradation level and its shed
-  // counters) so /metrics sees the overload plane without a new channel.
+  // counters, and the io-plane worker counters) so /metrics sees both the
+  // overload and the io plane without a new channel.
   std::string stats_text();
 
  private:
+  friend class IoWorker;
+
   void accept_loop();
-  // Returns true if the connection requested server shutdown.
-  bool handle_connection(int fd, std::shared_ptr<ClientMeta> meta);
-  std::string dispatch(const Command& cmd, bool* close_conn);
+  // Execute one parsed command, appending its response to `out` (values
+  // ride as moved payload segments). Sets *close_conn for SHUTDOWN.
+  void dispatch(const Command& cmd, OutQueue& out, bool* close_conn);
+  // Parse + dispatch one request line into `out`, with the per-command
+  // stats/latency/trace bookkeeping. Sets *close_conn for SHUTDOWN.
+  void run_command(const std::string& line,
+                   const std::shared_ptr<ClientMeta>& meta, OutQueue& out,
+                   bool* close_conn);
 
   // Serializes (engine write + event push) per key stripe so the staged
   // event order always matches the engine's final state for a key.
@@ -190,8 +295,15 @@ class Server {
   uint16_t bound_port_ = 0;
   std::thread accept_thread_;
   std::atomic<bool> stop_{false};
+  bool started_ = false;
   std::atomic<uint64_t> next_client_id_{1};
-  std::atomic<uint64_t> live_handlers_{0};
+
+  // The io worker pool. workers_live_ is the resolved width (0 until
+  // start()); next_worker_ deals accepted fds round-robin.
+  std::vector<std::unique_ptr<IoWorker>> workers_;
+  std::unique_ptr<IoWorkerStats[]> worker_stats_;
+  size_t workers_live_ = 0;
+  std::atomic<size_t> next_worker_{0};
 
   std::mutex clients_mu_;
   std::map<uint64_t, std::shared_ptr<ClientMeta>> clients_;

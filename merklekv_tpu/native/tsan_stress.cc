@@ -124,6 +124,109 @@ void stress_server_traffic() {
   server.wait();
 }
 
+// Pipelined bursts against the epoll worker pool: N clients, each sending
+// whole bursts of single-line-response commands in ONE send and reading
+// until every response line arrived — exercises the per-connection parse
+// carry, the coalesced writev flush, and the cross-worker engine/event
+// paths. A slow-reader client stalls mid-burst to push a connection
+// through the EAGAIN/backpressure path while its worker keeps serving the
+// others.
+void pipelined_worker(uint16_t port, int tid, int bursts, int depth) {
+  int fd = connect_to(port);
+  if (fd < 0) return;
+  for (int b = 0; b < bursts; ++b) {
+    std::string burst;
+    for (int j = 0; j < depth; ++j) {
+      char cmd[128];
+      switch ((b + j) % 4) {
+        case 0:
+          std::snprintf(cmd, sizeof(cmd), "SET p%d:%d value-%d-%d\r\n", tid,
+                        j % 29, b, j);
+          break;
+        case 1:
+          std::snprintf(cmd, sizeof(cmd), "GET p%d:%d\r\n", tid, j % 29);
+          break;
+        case 2:
+          std::snprintf(cmd, sizeof(cmd), "INC pc%d 1\r\n", tid);
+          break;
+        default:
+          std::snprintf(cmd, sizeof(cmd), "PING t%d\r\n", tid);
+          break;
+      }
+      burst += cmd;
+    }
+    if (::send(fd, burst.data(), burst.size(), MSG_NOSIGNAL) < 0) break;
+    int newlines = 0;
+    char buf[16384];
+    while (newlines < depth) {
+      ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+      if (r <= 0) {
+        ::close(fd);
+        return;
+      }
+      for (ssize_t i = 0; i < r; ++i) {
+        if (buf[i] == '\n') ++newlines;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void slow_reader_worker(uint16_t port, int gets) {
+  int fd = connect_to(port);
+  if (fd < 0) return;
+  std::string burst;
+  for (int i = 0; i < gets; ++i) burst += "GET bigkey\r\n";
+  if (::send(fd, burst.data(), burst.size(), MSG_NOSIGNAL) < 0) {
+    ::close(fd);
+    return;
+  }
+  // Stall before reading: the server's out queue for this connection must
+  // park behind EPOLLOUT / backpressure without wedging its worker.
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  int newlines = 0;
+  char buf[65536];
+  while (newlines < gets) {
+    ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+    if (r <= 0) break;
+    for (ssize_t i = 0; i < r; ++i) {
+      if (buf[i] == '\n') ++newlines;
+    }
+  }
+  ::close(fd);
+}
+
+void stress_pipelined_pool() {
+  mkv::MemEngine engine;
+  engine.set("bigkey", std::string(64 * 1024, 'B'));
+  mkv::ServerOptions opts;
+  opts.port = 0;
+  opts.io_threads = 4;
+  mkv::Server server(&engine, opts);
+  if (!server.start()) {
+    std::fprintf(stderr, "bind failed\n");
+    std::exit(1);
+  }
+  server.set_events_enabled(true);
+  std::atomic<bool> draining{true};
+  std::thread drainer([&] {
+    while (draining.load(std::memory_order_acquire)) {
+      server.events().drain(512);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  std::vector<std::thread> clients;
+  for (int t = 0; t < 12; ++t) {
+    clients.emplace_back(pipelined_worker, server.port(), t, 40, 32);
+  }
+  clients.emplace_back(slow_reader_worker, server.port(), 200);
+  for (auto& t : clients) t.join();
+  draining.store(false, std::memory_order_release);
+  drainer.join();
+  server.stop();
+  server.wait();
+}
+
 void stress_stop_races() {
   // stop() racing live connections + fresh connects: the historical hazard
   // (accept/stop handshake, clients_ table vs handler deregistration).
@@ -207,6 +310,8 @@ int main() {
   std::fprintf(stderr, "log engine: ok\n");
   stress_server_traffic();
   std::fprintf(stderr, "server traffic: ok\n");
+  stress_pipelined_pool();
+  std::fprintf(stderr, "pipelined pool: ok\n");
   stress_stop_races();
   std::fprintf(stderr, "stop races: ok\n");
   std::puts("TSAN STRESS PASS");

@@ -155,6 +155,11 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
         ctypes.c_int,
     ]
+    lib.mkv_server_configure_io.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+    ]
+    lib.mkv_server_io_threads.restype = ctypes.c_longlong
+    lib.mkv_server_io_threads.argtypes = [ctypes.c_void_p]
     lib.mkv_server_start.argtypes = [ctypes.c_void_p]
     lib.mkv_server_port.argtypes = [ctypes.c_void_p]
     lib.mkv_server_stopping.argtypes = [ctypes.c_void_p]
@@ -511,6 +516,8 @@ class NativeServer:
         port: int = 7379,
         version: str = "0.1.0",
         exit_on_shutdown: bool = False,
+        io_threads: int = 0,
+        pipelined: bool = True,
     ) -> None:
         self._lib = _load()
         self._engine = engine  # keep alive
@@ -521,10 +528,24 @@ class NativeServer:
         self._cb_ref = None
         if not self._h:
             raise NativeError("server create failed")
+        # I/O-plane shape, fixed before start: io_threads 0 = hardware
+        # concurrency, 1 = a single epoll loop; pipelined=False restores
+        # the one-write-per-response compat discipline (the bench A/B
+        # baseline approximating the old thread-per-connection server).
+        self._lib.mkv_server_configure_io(
+            self._h, io_threads, 1 if pipelined else 0
+        )
 
     def start(self) -> None:
         if not self._lib.mkv_server_start(self._h):
             raise NativeError("bind/listen failed")
+
+    @property
+    def io_threads(self) -> int:
+        """Resolved epoll worker-pool width (0 before start)."""
+        if not self._h:
+            return 0
+        return int(self._lib.mkv_server_io_threads(self._h))
 
     @property
     def port(self) -> int:
@@ -607,8 +628,9 @@ class NativeServer:
     ) -> None:
         """Admission-control limits: past ``max_connections`` (0 =
         unlimited) excess accepts are answered ``ERROR BUSY connections``
-        and closed before a handler thread exists; ``max_pipeline`` bounds
-        one connection's unanswered pipelined commands (0 = unlimited)."""
+        and closed before ever entering the io worker pool;
+        ``max_pipeline`` bounds one connection's unanswered pipelined
+        commands (0 = unlimited)."""
         if self._h:
             self._lib.mkv_server_set_limits(
                 self._h, max_connections, max_pipeline

@@ -193,6 +193,28 @@ CATALOG: dict[str, tuple[str, str]] = {
     "native.readonly_commands": (
         "counter", "Write commands answered ERROR READONLY while "
         "read-only/draining."),
+    # -- native io plane (epoll worker pool; per-worker families are
+    #    labeled {worker="i"}) ---------------------------------------------
+    "native.io_threads": (
+        "gauge", "Resolved epoll worker-pool width ([server] io_threads; "
+        "0 config = hardware concurrency)."),
+    "native.io_pipelined": (
+        "gauge", "1 when responses coalesce into one writev per burst; 0 "
+        "in the per-response-write compat mode (bench A/B baseline)."),
+    "native.io_worker_connections": (
+        "gauge", "Connections currently owned by each io worker."),
+    "native.io_worker_commands": (
+        "counter", "Commands dispatched by each io worker (with "
+        "io_worker_wakeups: loop depth = commands/wakeups)."),
+    "native.io_worker_wakeups": (
+        "counter", "epoll wakeups (event-loop turns with events) per io "
+        "worker."),
+    "native.io_worker_writev_calls": (
+        "counter", "Coalesced response flushes (writev syscalls) per io "
+        "worker."),
+    "native.io_worker_writev_bytes": (
+        "counter", "Bytes flushed by each io worker's writev calls (with "
+        "writev_calls: mean bytes per flush)."),
 }
 
 

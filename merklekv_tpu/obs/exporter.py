@@ -40,6 +40,17 @@ __all__ = ["MetricsExporter", "render_prometheus"]
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 # Native STATS histogram lines: cmd_latency_us_le_<bound|inf>:count
 _NATIVE_BUCKET_RE = re.compile(r"^cmd_latency_us_le_(\d+|inf)$")
+# Per-io-worker STATS lines (io_worker_<i>_<field>): folded into ONE
+# labeled family per field instead of one family per worker index.
+_IO_WORKER_RE = re.compile(r"^io_worker_(\d+)_([a-z_]+)$")
+# field -> Prometheus kind for the labeled io-worker families.
+_IO_WORKER_KINDS = {
+    "connections": "gauge",
+    "commands": "counter",
+    "wakeups": "counter",
+    "writev_calls": "counter",
+    "writev_bytes": "counter",
+}
 
 
 def _san(name: str) -> str:
@@ -215,11 +226,40 @@ def render_prometheus(
         hist_lines = _native_histogram(stats)
         if hist_lines:
             out.extend(hist_lines)
+        # io plane: one labeled family per per-worker field
+        # (mkv_native_io_worker_<field>{worker="i"}) instead of a family
+        # per worker index — PromQL can sum/max across workers.
+        io_fields: dict[str, dict[int, float]] = {}
+        for name, value in stats.items():
+            m = _IO_WORKER_RE.match(name)
+            if m is None or m.group(2) not in _IO_WORKER_KINDS:
+                continue
+            try:
+                io_fields.setdefault(m.group(2), {})[int(m.group(1))] = (
+                    float(value)
+                )
+            except ValueError:
+                continue
+        for field in sorted(io_fields):
+            kind = _IO_WORKER_KINDS[field]
+            fam = f"mkv_native_io_worker_{field}"
+            out.append(
+                f"# HELP {fam} " + help_for(f"native.io_worker_{field}", kind)
+            )
+            out.append(f"# TYPE {fam} {kind}")
+            for worker in sorted(io_fields[field]):
+                out.append(
+                    f'{fam}{{worker="{worker}"}} '
+                    f"{_fmt(io_fields[field][worker])}"
+                )
         for name in sorted(stats):
             if _NATIVE_BUCKET_RE.match(name) or name.startswith(
                 "cmd_latency_us_"
             ):
                 continue  # folded into the histogram above
+            m = _IO_WORKER_RE.match(name)
+            if m is not None and m.group(2) in _IO_WORKER_KINDS:
+                continue  # folded into the labeled families above
             try:
                 num = float(stats[name])
             except ValueError:

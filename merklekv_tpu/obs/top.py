@@ -4,8 +4,11 @@ Polls STATS / INFO / METRICS / PEERS across a node list over the normal
 wire protocol (no exporter needed), computes per-interval rates from
 successive counter samples, and renders one table per refresh:
 
-    NODE  KEYS  OPS/S  SET/S  GET/S  P50_US  SYNC_KB/S  CONN  PEERS_UP
-    LAG_EV  LAG_MS  READY  STATE  SHED/S  STATUS
+    NODE  KEYS  OPS/S  SET/S  GET/S  P50_US  SYNC_KB/S  CONNS  W  OPS/S/W
+    PEERS_UP  LAG_EV  LAG_MS  READY  STATE  SHED/S  STATUS
+
+(CONNS = active connections; W = epoll worker-pool width; OPS/S/W = the
+busiest io worker's command rate, the pool-imbalance signal.)
 
 ``--once`` prints a single frame (two quick samples for rates) and exits —
 scriptable and testable; without it the screen refreshes every
@@ -55,6 +58,12 @@ class NodeSample:
     # STATE and SHED/s columns ("-" on nodes predating the ladder).
     state: str = "-"
     shed_total: int = 0
+    # io plane (STATS io_threads / io_worker_<i>_commands lines): pool
+    # width and per-worker cumulative command counts — rendered as the W
+    # and OPS/S/W (busiest worker's rate) columns ("-" on nodes predating
+    # the worker pool).
+    io_threads: int = 0
+    worker_commands: dict = field(default_factory=dict)
 
 
 def _p50_from_stats(stats: dict[str, str]) -> Optional[float]:
@@ -104,6 +113,16 @@ def sample_node(node: str, timeout: float = 2.0) -> NodeSample:
     s.get_commands = int(stats.get("get_commands", 0) or 0)
     s.active_connections = int(stats.get("active_connections", 0) or 0)
     s.latency_p50_us = _p50_from_stats(stats)
+    try:
+        s.io_threads = int(stats.get("io_threads", 0) or 0)
+    except ValueError:
+        pass
+    for name, value in stats.items():
+        if name.startswith("io_worker_") and name.endswith("_commands"):
+            try:
+                s.worker_commands[name] = int(value)
+            except ValueError:
+                continue
     s.sync_bytes = int(metrics.get("sync.bytes_sent", 0) or 0) + int(
         metrics.get("sync.bytes_received", 0) or 0
     )
@@ -144,7 +163,8 @@ def render_table(
 ) -> str:
     header = (
         f"{'NODE':<22} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} {'GET/S':>8} "
-        f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONN':>5} {'PEERS_UP':>9} "
+        f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONNS':>5} {'W':>3} "
+        f"{'OPS/S/W':>8} {'PEERS_UP':>9} "
         f"{'LAG_EV':>7} {'LAG_MS':>8} {'READY':>8} {'STATE':>9} "
         f"{'SHED/S':>7} STATUS"
     )
@@ -154,7 +174,8 @@ def render_table(
         p = prev.get(node)
         if not c.ok:
             lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
-                         f"{'-':>7} {'-':>10} {'-':>5} {'-':>9} "
+                         f"{'-':>7} {'-':>10} {'-':>5} {'-':>3} {'-':>8} "
+                         f"{'-':>9} "
                          f"{'-':>7} {'-':>8} {'-':>8} {'-':>9} {'-':>7} "
                          f"DOWN ({c.error})")
             continue
@@ -166,13 +187,24 @@ def render_table(
             _rate(c.sync_bytes, p.sync_bytes, dt) / 1024.0 if dt else 0.0
         )
         shed = _rate(c.shed_total, p.shed_total, dt) if dt else 0.0
+        # Busiest io worker's command rate: the imbalance signal — one hot
+        # worker with the rest idle reads very differently from an even
+        # OPS/S / W split.
+        per_worker = 0.0
+        if dt and c.worker_commands:
+            per_worker = max(
+                _rate(v, p.worker_commands.get(k, v), dt)
+                for k, v in c.worker_commands.items()
+            )
         p50 = f"{c.latency_p50_us:.0f}" if c.latency_p50_us else "-"
         peers = (
             f"{c.peers_up}/{c.peers_total}" if c.peers_total else "-"
         )
+        w = str(c.io_threads) if c.io_threads else "-"
         lines.append(
             f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
             f"{p50:>7} {sync_kb:>10.1f} {c.active_connections:>5} "
+            f"{w:>3} {per_worker:>8.1f} "
             f"{peers:>9} {c.lag_events:>7} {c.lag_ms:>8.1f} "
             f"{c.readiness:>8} {c.state:>9} {shed:>7.1f} UP"
         )

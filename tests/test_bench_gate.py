@@ -56,6 +56,14 @@ def test_direction_rules():
                                       "bytes (bisect walk)")
     assert bench_gate.lower_is_better("set_metrics_overhead_pct",
                                       "% (median)")
+    # The many-connection pipelined scenario gates as throughput: its
+    # aggregate ops/s must not DROP round-over-round.
+    assert not bench_gate.lower_is_better(
+        "many_conn_throughput",
+        "ops/s (64 conns x pipelined GET/SET, depth 32)",
+    )
+    assert not bench_gate.lower_is_better("overload_goodput",
+                                          "ops/s (accepted)")
 
 
 def test_compare_flags_only_real_regressions():

@@ -232,3 +232,39 @@ def test_backend_probe_is_bounded(monkeypatch):
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.delenv("MERKLEKV_JAX_PLATFORM", raising=False)
     assert probe_default_backend(timeout=0.001) is None
+
+
+def test_bench_main_rc0_under_poisoned_jax_platforms():
+    """Regression for the BENCH_r05 failure shape: a real `python bench.py`
+    subprocess with JAX_PLATFORMS poisoned to an unusable platform must
+    STILL exit 0 with one parsable JSON record on stdout — the raw
+    `jax.default_backend()` crash path must stay routed through the
+    bounded-probe/fallback contract."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "bogusplatform"  # pinned AND unusable
+    env.pop("MERKLEKV_JAX_PLATFORM", None)
+    env["MKV_BENCH_PROBE_TIMEOUT"] = "15"
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=root,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, (out.returncode, out.stderr[-2000:])
+    records = [
+        ln for ln in out.stdout.splitlines() if ln.strip().startswith("{")
+    ]
+    assert records, f"no JSON on stdout: {out.stdout!r}"
+    rec = json.loads(records[-1])
+    assert rec["metric"] == "merkle_rebuild_diff_keys_per_s"
+    # A poisoned platform cannot produce a number; the record must carry
+    # the failure instead of the process carrying a traceback + rc 1.
+    assert rec["value"] is None
+    assert rec.get("error")

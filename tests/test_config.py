@@ -137,6 +137,7 @@ def test_server_overload_section_parse(tmp_path):
     p.write_text(
         """
 [server]
+io_threads = 6
 max_connections = 4096
 max_pipeline = 256
 memory_soft_bytes = 1073741824
@@ -153,6 +154,7 @@ disk_free_hard_bytes = 67108864
 """
     )
     cfg = Config.load(str(p))
+    assert cfg.server.io_threads == 6
     assert cfg.server.max_connections == 4096
     assert cfg.server.max_pipeline == 256
     assert cfg.server.memory_soft_bytes == 1 << 30
@@ -166,6 +168,7 @@ disk_free_hard_bytes = 67108864
 
 def test_server_overload_defaults_off():
     cfg = Config.from_dict({})
+    assert cfg.server.io_threads == 0  # 0 = hardware concurrency
     assert cfg.server.max_connections == 0
     assert cfg.server.memory_soft_bytes == 0
     assert cfg.server.memory_hard_bytes == 0
@@ -179,6 +182,8 @@ def test_server_overload_validation():
 
     with pytest.raises(ValueError, match="max_connections"):
         Config.from_dict({"server": {"max_connections": -1}})
+    with pytest.raises(ValueError, match="io_threads"):
+        Config.from_dict({"server": {"io_threads": -1}})
     with pytest.raises(ValueError, match="memory_soft_bytes"):
         # soft above hard: shedding could never precede read-only.
         Config.from_dict(

@@ -56,3 +56,44 @@ def test_multichip_phase_breadcrumbs(tmp_path, monkeypatch, capsys):
     assert "serving-tree" in names
     # Every completed phase carries its wall time.
     assert all("seconds" in p for p in doc["phases"])
+
+
+def test_watchdog_exits_with_sidecar_and_record(tmp_path):
+    """A hung probe must die by the INTERNAL watchdog, not the driver's
+    rc=124 kill: exit 3, a partial JSON record on stdout naming the stuck
+    phase, and the phase sidecar closed out with a watchdog-timeout entry
+    (MULTICHIP_r01-r05 all died rc=124 with only a stderr tail)."""
+    import json
+    import subprocess
+
+    phase_file = tmp_path / "phases.json"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = "\n".join(
+        [
+            "import os, sys, time",
+            "os.environ['MKV_MULTICHIP_DEADLINE_S'] = '1'",
+            f"os.environ['MKV_PHASE_FILE'] = {str(phase_file)!r}",
+            f"sys.path.insert(0, {root!r})",
+            "import __graft_entry__ as g",
+            "g._start_watchdog()",
+            "g._phase('mesh-init-sim')",
+            "time.sleep(60)  # simulated hang: never reaches 'done'",
+        ]
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    assert out.returncode == 3, (out.returncode, out.stderr[-1000:])
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["ok"] is False
+    assert "mesh-init-sim" in rec["error"]
+    assert any(p["phase"] == "mesh-init-sim" for p in rec["phases"])
+    doc = json.loads(phase_file.read_text())
+    names = [p["phase"] for p in doc["phases"]]
+    assert "watchdog-timeout" in names
+    # The stuck phase's elapsed time was closed out by the final rewrite.
+    stuck = [p for p in doc["phases"] if p["phase"] == "mesh-init-sim"]
+    assert stuck and "seconds" in stuck[0]

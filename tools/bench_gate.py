@@ -7,9 +7,10 @@ newest rounds that produced usable numbers and exits 1 when any common
 scenario regressed beyond the threshold — so a perf-eating change can't
 ride a green CI into main.
 
-Direction matters: throughput units (``keys/s``, ``events/s``, ...) must
-not DROP; latency/size/overhead units (``ms``, ``us``, ``bytes``, ``%``)
-must not RISE. Rounds that crashed (rc != 0, no scenarios, null values)
+Direction matters: throughput units (``keys/s``, ``events/s``,
+``ops/s`` — e.g. the ``many_conn_throughput`` and ``overload_goodput``
+scenarios) must not DROP; latency/size/overhead units (``ms``, ``us``,
+``bytes``, ``%``) must not RISE. Rounds that crashed (rc != 0, no scenarios, null values)
 are skipped rather than compared — a broken round is the driver's failure
 signal, not a baseline; with fewer than two usable rounds the gate warns
 and passes.
