@@ -34,7 +34,11 @@ The remaining BASELINE.json configs print one JSON line each on STDERR
     histogram snapshot embedded in the record;
   - many_conn_throughput: native-server I/O plane A/B — aggregate ops/s +
     p99 burst round-trip for 64 pipelined connections against the epoll
-    worker pool vs the io_threads=1 unpipelined compat baseline.
+    worker pool vs the io_threads=1 unpipelined compat baseline;
+  - flight_overhead_pct: flight-recorder A/B — throughput cost of the
+    always-on black box (slow-command threshold + 1 s metric sampler +
+    periodic spill) under the pipelined many-connection load; down-good,
+    acceptance bar < 5%.
 
 Off-TPU the sizes shrink to smoke-test values so the script stays runnable
 in CI; the driver's real run happens on the chip.
@@ -775,6 +779,144 @@ def bench_many_conn_throughput(
     }
 
 
+def bench_flight_overhead(
+    n_conns: int = 16, depth: int = 32, bursts: int = 20, rounds: int = 3
+) -> dict:
+    """Flight-recorder cost under the pipelined many-connection load.
+
+    The black box is always-on by design, so its budget is strict: the
+    hot-path cost is ONE extra relaxed atomic load per dispatch (the
+    slow-command threshold check rides the latency histogram's existing
+    clock reads), plus a 1 s metric sampler and a periodic spill rewrite
+    entirely off the request path. A/B the full plane — native threshold
+    at the production default, sampler at 1 Hz, spiller writing a real
+    file — against everything off, over INTERLEAVED rounds of the
+    pipelined burst load (the worst case: maximal dispatches/second), and
+    report the median throughput cost as a percentage. Down-good in
+    tools/bench_gate.py (metric ends in _pct); acceptance bar < 5%."""
+    import socket
+    import statistics as stats
+    import tempfile
+    import threading
+
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+    from merklekv_tpu.obs import flightrec
+
+    val = b"v" * 64
+    n_keys = 1024
+
+    def load_once(srv_port: int) -> float:
+        payloads = []
+        for c in range(n_conns):
+            cmds = []
+            for j in range(depth):
+                k = b"fo:%05d" % ((c * 131 + j * 17) % n_keys)
+                cmds.append(
+                    (b"GET " + k + b"\r\n")
+                    if j % 2
+                    else (b"SET " + k + b" " + val + b"\r\n")
+                )
+            payloads.append(b"".join(cmds))
+        socks = [
+            socket.create_connection(("127.0.0.1", srv_port), timeout=30)
+            for _ in range(n_conns)
+        ]
+        n_threads = min(4, n_conns)
+        per = (n_conns + n_threads - 1) // n_threads
+        start_evt = threading.Event()
+        errors: list[BaseException] = []
+
+        def driver(t: int) -> None:
+            mine = range(t * per, min((t + 1) * per, n_conns))
+            buf = bytearray(1 << 16)
+            try:
+                start_evt.wait()
+                for _ in range(bursts):
+                    for ci in mine:
+                        socks[ci].sendall(payloads[ci])
+                    for ci in mine:
+                        got = 0
+                        while got < depth:
+                            n = socks[ci].recv_into(buf)
+                            if n == 0:
+                                raise ConnectionError("server closed")
+                            got += buf.count(b"\n", 0, n)
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=driver, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for th in threads:
+            th.start()
+        t0 = time.perf_counter()
+        start_evt.set()
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        for s in socks:
+            s.close()
+        if errors:
+            raise errors[0]
+        return n_conns * depth * bursts / dt
+
+    import shutil
+
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0)
+    srv.start()
+    spill_dir = tempfile.mkdtemp(prefix="mkv-flight-bench-")
+    try:
+        for i in range(n_keys):
+            eng.set(b"fo:%05d" % i, val)
+        load_once(srv.port)  # warm the allocator + worker pool
+
+        def flight(on: bool):
+            if not on:
+                srv.set_slow_threshold(0)
+                return None, None
+            srv.set_slow_threshold(10_000)  # the production default
+            sampler = flightrec.MetricSampler(
+                interval_s=1.0, stats_fn=srv.stats_text
+            ).start()
+            spiller = flightrec.FlightSpiller(
+                spill_dir, sampler=sampler, interval_s=1.0,
+                node="flight-bench",
+            ).start()
+            return sampler, spiller
+
+        on_s, off_s = [], []
+        for _ in range(rounds):
+            sampler, spiller = flight(True)
+            on_s.append(load_once(srv.port))
+            spiller.stop(final=False)
+            sampler.stop()
+            flight(False)
+            off_s.append(load_once(srv.port))
+        on_med, off_med = stats.median(on_s), stats.median(off_s)
+        # Signed, like set_metrics_overhead_pct: noise can favor "on", and
+        # the gate's value>0 filter already skips a sub-noise round.
+        overhead_pct = (1.0 - on_med / off_med) * 100.0
+        return {
+            "metric": "flight_overhead_pct",
+            "value": round(overhead_pct, 2),
+            "unit": "% (median throughput cost, recorder+sampler on vs off)",
+            "conns": n_conns,
+            "depth": depth,
+            "bursts_per_round": bursts,
+            "rounds": rounds,
+            "on_med_ops_per_s": round(on_med, 1),
+            "off_med_ops_per_s": round(off_med, 1),
+            "target": 5.0,
+            "target_met": overhead_pct < 5.0,
+        }
+    finally:
+        srv.close()
+        eng.close()
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
 def bench_overload_goodput(duration_s: float = 1.5) -> dict:
     """Overload protection under ~2x offered load: goodput, shed rate, and
     read p99 while the node sheds writes above its memory watermark.
@@ -1075,6 +1217,12 @@ def _run(backend: str) -> None:
         )
     except Exception as e:
         print(f"# many_conn_throughput bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(
+            bench_flight_overhead(bursts=40 if on_tpu else 20)
+        )
+    except Exception as e:
+        print(f"# flight_overhead bench failed: {e!r}", file=sys.stderr)
 
     # Every emitted record carries the run's metrics snapshot (counters +
     # span aggregates) so a BENCH_*.json trajectory shows what the run

@@ -30,6 +30,13 @@ def main(argv: list[str] | None = None) -> int:
         from merklekv_tpu.obs.top import main as top_main
 
         return top_main(argv[1:])
+    if argv and argv[0] == "blackbox":
+        # Offline post-mortem: merge flight-recorder spills from one or
+        # more nodes into an ordered cluster timeline + anomaly report
+        # (docs/OBSERVABILITY.md "Post-mortem forensics").
+        from merklekv_tpu.obs.blackbox import main as blackbox_main
+
+        return blackbox_main(argv[1:])
     if argv and argv[0] == "trace":
         # Cross-node causal-trace assembly: TRACEDUMP from every node,
         # stitched into one Perfetto-loadable Chrome trace

@@ -651,6 +651,17 @@ class MerkleKVClient:
             raise ProtocolError(f"unexpected response: {resp}")
         return self._read_field_table()
 
+    def flight(self, n: int = 64) -> list[dict[str, str]]:
+        """Flight-recorder stream (FLIGHT extension verb): the newest ``n``
+        black-box events — state transitions, slow commands — one dict per
+        event (seq/wall_ns/kind + kind-specific fields), newest first. A
+        bare native node serves its slow-command log; a node with a control
+        plane serves the full event ring."""
+        resp = _parse_simple(self._request(f"FLIGHT {n}"))
+        if not resp.startswith("EVENTS "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        return self._read_field_table()
+
     def profile(self, seconds: int) -> str:
         """Start a bounded device-profiler capture (PROFILE extension
         verb); returns the capture directory on the serving node. Raises
@@ -967,6 +978,20 @@ class AsyncMerkleKVClient:
         ``trace_dump``."""
         resp = _parse_simple(await self._request(f"TRACEDUMP {n}"))
         if not resp.startswith("SPANS "):
+            raise ProtocolError(f"unexpected response: {resp}")
+        rows = []
+        while True:
+            line = await self._read_line()
+            if line == "END":
+                return rows
+            rows.append(
+                dict(f.split("=", 1) for f in line.split(" ") if "=" in f)
+            )
+
+    async def flight(self, n: int = 64) -> list[dict[str, str]]:
+        """Async FLIGHT — same semantics as the sync client's ``flight``."""
+        resp = _parse_simple(await self._request(f"FLIGHT {n}"))
+        if not resp.startswith("EVENTS "):
             raise ProtocolError(f"unexpected response: {resp}")
         rows = []
         while True:

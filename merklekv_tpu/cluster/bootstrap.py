@@ -155,6 +155,12 @@ class BootstrapSession:
     def _enter(self, state: str) -> None:
         with self._state_mu:
             self._state = state
+        # Flight recorder: bootstrap phases are the classic "died mid-join"
+        # forensic question — the spill's tail names how far the session
+        # got (discover/fetch/verify/delta/live/failed).
+        from merklekv_tpu.obs.flightrec import record
+
+        record("bootstrap", state=state)
 
     def _serving(self) -> None:
         """Open the gate exactly once per run (idempotent safety net: the

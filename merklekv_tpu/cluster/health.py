@@ -118,6 +118,7 @@ class PeerHealthMonitor:
 
     def _record(self, peer: str, ok: bool, rtt_ms: float) -> None:
         now = time.time()
+        flip = None  # (prev, new) outside the lock
         with self._mu:
             h = self._health.get(peer)
             if h is None:
@@ -127,6 +128,8 @@ class PeerHealthMonitor:
             if ok:
                 if h.status == "down":
                     get_metrics().inc("health.peer_recoveries")
+                if h.status not in ("up", "unknown"):
+                    flip = (h.status, "up")
                 h.status = "up"
                 h.consecutive_failures = 0
                 h.last_ok_unix = now
@@ -138,8 +141,15 @@ class PeerHealthMonitor:
                     h.consecutive_failures >= self._down_after
                     and h.status != "down"
                 ):
+                    flip = (h.status, "down")
                     h.status = "down"
                     get_metrics().inc("health.peer_failures")
+        if flip is not None:
+            # Flight recorder: peer state FLIPS only (the steady state is
+            # noise; transitions are the timeline).
+            from merklekv_tpu.obs.flightrec import record
+
+            record("peer_health", peer=peer, prev=flip[0], new=flip[1])
 
     def _run(self) -> None:
         # First round immediately so the table is useful right away.
@@ -158,14 +168,22 @@ class PeerHealthMonitor:
         still succeed. The table shows it, metrics count it, and the next
         successful probe clears it. Peers not in the configured list are
         added so ad-hoc sync targets surface too."""
+        flipped_from = None
         with self._mu:
             h = self._health.get(peer)
             if h is None:
                 h = self._health[peer] = PeerHealth(peer=peer)
             h.last_error = reason
             if h.status != "down":
+                if h.status != "degraded":
+                    flipped_from = h.status
                 h.status = "degraded"
         get_metrics().inc("health.peer_degradations")
+        if flipped_from is not None:
+            from merklekv_tpu.obs.flightrec import record
+
+            record("peer_health", peer=peer, prev=flipped_from,
+                   new="degraded", reason=reason)
 
     # -- queries -------------------------------------------------------------
     def is_up(self, peer: str) -> bool:

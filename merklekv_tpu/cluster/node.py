@@ -67,6 +67,10 @@ class ClusterNode:
         # One PROFILE capture at a time; directory returned on start.
         self._profile_mu = threading.Lock()
         self._profiling = False
+        # Flight recorder (post-mortem black box): sampler + durable spill
+        # started in start() per [observability] flight settings.
+        self._flight_sampler = None
+        self._flight_spiller = None
         # Overload-protection plane: the node-wide degradation ladder
         # (live -> shedding -> read_only -> draining), fed by the memory /
         # disk watermark monitor and enforced by the native server.
@@ -110,6 +114,7 @@ class ClusterNode:
         tracewire.get_collector().set_capacity(
             self._cfg.observability.trace_spans
         )
+        self._start_flight_recorder()
         if self._cfg.observability.http_port != 0:
             # Per-node Prometheus endpoint (/metrics + /healthz): registry
             # counters/histograms/gauges and the native STATS block in one
@@ -169,6 +174,64 @@ class ClusterNode:
         if not bootstrapping:
             self._start_sync_loop()
 
+    def _flight_dir(self) -> Optional[str]:
+        """Where the durable spill lives: explicit [observability]
+        flight_dir wins; "" resolves to <node data dir>/flight on durable
+        nodes and to None (spill off; ring + FLIGHT verb still live) on
+        storage-less ones — an embedded test node must not litter."""
+        d = self._cfg.observability.flight_dir
+        if d:
+            return d
+        if self._storage is not None:
+            return os.path.join(self._storage.directory, "flight")
+        return None
+
+    def _start_flight_recorder(self) -> None:
+        """Arm the black box: size the ring, push the native slow-command
+        threshold, start the metric sampler, and — when a spill directory
+        resolves — the periodic spill writer plus the fatal-dump handlers
+        (faulthandler first, then the native crash marker so the marker
+        chains INTO faulthandler's traceback dump)."""
+        obs = self._cfg.observability
+        if not obs.flight_enabled:
+            # Disarm explicitly: an embedded server reused from a previous
+            # node (or configured by one) may still hold its threshold.
+            self._server.set_slow_threshold(0)
+            return
+        from merklekv_tpu.obs import flightrec
+
+        rec = flightrec.get_recorder()
+        rec.set_capacity(obs.flight_events)
+        self._server.set_slow_threshold(obs.slow_command_us)
+        rec.record("node_start", port=self._server.port)
+        self._flight_sampler = flightrec.MetricSampler(
+            interval_s=obs.flight_sample_s,
+            stats_fn=self._server.stats_text,
+        ).start()
+        flight_dir = self._flight_dir()
+        if flight_dir is not None:
+            self._flight_spiller = flightrec.FlightSpiller(
+                flight_dir,
+                sampler=self._flight_sampler,
+                interval_s=obs.flight_spill_s,
+                node=f"{self._cfg.host}:{self._server.port}",
+            )
+            try:
+                self._flight_spiller.start()
+            except OSError as e:
+                # An unwritable flight dir must not kill the data plane;
+                # the in-memory ring and FLIGHT verb still serve.
+                self._flight_spiller = None
+                print(f"flight spill not started: {e}", file=sys.stderr,
+                      flush=True)
+            if self._flight_spiller is not None:
+                flightrec.install_fault_handlers(flight_dir)
+                from merklekv_tpu.native_bindings import install_crash_marker
+
+                install_crash_marker(
+                    os.path.join(flight_dir, "fatal.txt")
+                )
+
     def _start_sync_loop(self) -> None:
         if (
             self._cfg.anti_entropy.enabled
@@ -218,6 +281,25 @@ class ClusterNode:
         # a node stops (the process-level path closes it right after, so
         # the draining window there lasts until server.close()).
         self._server.set_degradation(0, 0)
+        # Disarm the slow-command log with the rest of the per-node server
+        # state: a successor node attached to the same embedded server
+        # must not inherit this node's threshold.
+        self._server.set_slow_threshold(0)
+        # Flight recorder LAST: node_stop is the clean-shutdown marker —
+        # FAULT_MODEL.md's contract is that its PRESENCE in the spill's
+        # tail proves the stop completed, so it must be recorded (and the
+        # final spill written) after the whole teardown above, not before
+        # it. A death mid-teardown then still reads as unclean.
+        if self._flight_sampler is not None or self._flight_spiller is not None:
+            from merklekv_tpu.obs import flightrec
+
+            flightrec.record("node_stop")
+        if self._flight_sampler is not None:
+            self._flight_sampler.stop()
+        if self._flight_spiller is not None:
+            self._flight_spiller.stop(final=True)
+            self._flight_spiller = None
+        self._flight_sampler = None
 
     @property
     def replicator(self) -> Optional[Replicator]:
@@ -816,6 +898,37 @@ class ClusterNode:
             # initiator's trace id, parented to the span id the token
             # carried. "TRACESPAN <VERB> <tc=token> <start_ns> <dur_ns>".
             return self._record_trace_span(parts[1:])
+        if parts[0] == "FLIGHT":
+            # Flight-recorder stream: the full python event ring (which
+            # includes native slow commands relayed via SLOWCMD below).
+            from merklekv_tpu.obs.flightrec import get_recorder
+
+            n = int(parts[1]) if len(parts) > 1 else 64
+            return get_recorder().wire_dump(n)
+        if parts[0] == "SLOWCMD":
+            # Native notification: a dispatch crossed the slow-command
+            # threshold. "SLOWCMD <VERB> <dur_us> <addr> [tc=token]" —
+            # a traced serve carries the initiator's token, and stamping
+            # its trace id here is what lets blackbox link this node's
+            # slow serve to the initiator's cycle across spills.
+            # Malformed notifications drop (never an error into native
+            # dispatch).
+            from merklekv_tpu.obs.flightrec import record
+
+            try:
+                fields = {
+                    "verb": parts[1],
+                    "dur_us": int(parts[2]),
+                    "conn": parts[3],
+                }
+                if len(parts) > 4:
+                    ctx = tracewire.parse_token(parts[4])
+                    if ctx is not None:
+                        fields["trace"] = f"{ctx.trace_id:016x}"
+                record("slow_command", **fields)
+            except (IndexError, ValueError):
+                pass
+            return "OK\r\n"
         if parts[0] == "PROFILE":
             return self._profile_wire(int(parts[1]))
         if parts[0] == "HASH":

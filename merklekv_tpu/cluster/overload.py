@@ -206,6 +206,17 @@ class OverloadMonitor:
             self._pushed = (level, reason)
             if level != prev:
                 get_metrics().inc("node.degradation_changes")
+                # Flight recorder: ladder flips are exactly the "what was
+                # the node doing before it died" signal a post-mortem
+                # timeline starts from.
+                from merklekv_tpu.obs.flightrec import record
+
+                record(
+                    "degradation",
+                    prev=LEVEL_NAMES.get(prev, prev),
+                    new=LEVEL_NAMES.get(level, level),
+                    reason=reason,
+                )
                 print(
                     f"overload: {LEVEL_NAMES.get(prev, prev)} -> "
                     f"{LEVEL_NAMES.get(level, level)}"

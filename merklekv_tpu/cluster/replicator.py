@@ -452,6 +452,15 @@ class Replicator:
             m.inc("replicator.skew_clamped", total)
             for src, n in clamped_by_src.items():
                 m.inc(f"replicator.skew_clamped.{src or 'unknown'}", n)
+            # Flight recorder: a poisoned clock upstream is a classic
+            # slow-burn failure — the clamp burst belongs on the timeline.
+            from merklekv_tpu.obs.flightrec import record
+
+            record(
+                "skew_clamp",
+                count=total,
+                srcs=",".join(sorted(s or "unknown" for s in clamped_by_src)),
+            )
         return out
 
     def _apply_frame(

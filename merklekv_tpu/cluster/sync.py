@@ -274,13 +274,17 @@ class SyncManager:
     # -- causal tracing -------------------------------------------------------
     @staticmethod
     def _cycle_trace_scope():
-        """A fresh trace root for one anti-entropy cycle, or a no-op scope
-        when propagation is disabled ([observability] trace_propagation)."""
+        """(scope, ctx) — a fresh trace root for one anti-entropy cycle,
+        or a no-op scope with ctx None when propagation is disabled
+        ([observability] trace_propagation). The ctx rides separately
+        because the cycle summary is appended AFTER the scope exits (the
+        flight recorder stamps its trace id for cross-node spill joins)."""
         import contextlib
 
         if not tracewire.propagation_enabled():
-            return contextlib.nullcontext()
-        return tracewire.trace_scope(tracewire.new_context())
+            return contextlib.nullcontext(), None
+        scope = tracewire.trace_scope(tracewire.new_context())
+        return scope, scope.ctx
 
     @staticmethod
     def _attach_trace(client: MerkleKVClient) -> MerkleKVClient:
@@ -378,11 +382,15 @@ class SyncManager:
         trace = PeerTrace(peer=peer)
         started, t0 = time.time(), time.perf_counter()
         cid = next_cycle_id()
+        # Bind the scope so its trace id survives into the finally — the
+        # summary is appended after the scope exits, and the flight
+        # recorder needs the id for cross-node spill joins.
+        tscope, tctx = self._cycle_trace_scope()
         try:
             # Causal trace root for the whole cycle: spans inside stitch
             # under it, and the clients' trace tokens carry it to the peer
             # so the donor's serve spans land under the SAME trace id.
-            with self._cycle_trace_scope(), cycle_scope(cid), \
+            with tscope, cycle_scope(cid), \
                     span("anti_entropy.sync_once", peer=peer) as rec:
                 report = self._sync_once(host, port, full, verify,
                                          trace=trace)
@@ -402,6 +410,7 @@ class SyncManager:
             get_trace_buffer().append(CycleTrace(
                 cycle_id=cid, kind="pairwise", started_unix=started,
                 seconds=time.perf_counter() - t0, peers=[trace],
+                trace_id=tctx.trace_id if tctx is not None else 0,
             ))
 
     def _sync_once(
@@ -1475,8 +1484,9 @@ class SyncManager:
         traces = {p: PeerTrace(peer=p, mode="multi") for p in peers}
         started, t0 = time.time(), time.perf_counter()
         cid = next_cycle_id()
+        tscope, tctx = self._cycle_trace_scope()
         try:
-            with self._cycle_trace_scope(), cycle_scope(cid), \
+            with tscope, cycle_scope(cid), \
                     span("anti_entropy.sync_multi",
                          peers=",".join(peers)) as rec:
                 report = self._sync_multi(peers, traces=traces)
@@ -1498,6 +1508,7 @@ class SyncManager:
                 cycle_id=cid, kind="multi", started_unix=started,
                 seconds=time.perf_counter() - t0,
                 peers=list(traces.values()),
+                trace_id=tctx.trace_id if tctx is not None else 0,
             ))
 
     def _sync_multi(

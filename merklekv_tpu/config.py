@@ -313,6 +313,25 @@ class ObservabilityConfig:
     # PROFILE verb capture directory ("" = <storage_path>/profiles or a
     # temp dir on storage-less nodes).
     profile_dir: str = ""
+    # Flight recorder (post-mortem black box, obs/flightrec.py). Always on
+    # in-memory (event ring + FLIGHT verb); the durable spill only writes
+    # when a directory resolves — flight_dir "" means <node data dir>/flight
+    # on durable nodes and NO spill on storage-less ones (an embedded test
+    # node must not litter the filesystem).
+    flight_enabled: bool = True
+    flight_dir: str = ""
+    # Event-ring capacity (state transitions + slow commands).
+    flight_events: int = 2048
+    # Metric-sampler cadence: counters + gauges + native STATS snapshot
+    # every flight_sample_s into a ~15 min ring, so "what changed in the
+    # 60 s before death" is always answerable from the spill.
+    flight_sample_s: float = 1.0
+    # Spill rewrite cadence (atomic tmp+rename; kill -9 always leaves the
+    # previous complete spill).
+    flight_spill_s: float = 10.0
+    # Slow-command log threshold in MICROSECONDS: native dispatch records
+    # verb/latency/connection for commands at or over it. 0 disables.
+    slow_command_us: int = 10_000
 
 
 @dataclass
@@ -487,6 +506,38 @@ class Config:
             )
         if "profile_dir" in obs:
             cfg.observability.profile_dir = str(obs["profile_dir"])
+        if "flight_enabled" in obs:
+            cfg.observability.flight_enabled = bool(obs["flight_enabled"])
+        if "flight_dir" in obs:
+            cfg.observability.flight_dir = str(obs["flight_dir"])
+        if "flight_events" in obs:
+            cfg.observability.flight_events = int(obs["flight_events"])
+        if "flight_sample_s" in obs:
+            cfg.observability.flight_sample_s = float(obs["flight_sample_s"])
+        if "flight_spill_s" in obs:
+            cfg.observability.flight_spill_s = float(obs["flight_spill_s"])
+        if "slow_command_us" in obs:
+            cfg.observability.slow_command_us = int(obs["slow_command_us"])
+        if cfg.observability.flight_events < 16:
+            raise ValueError(
+                "[observability] flight_events must be >= 16, got "
+                f"{cfg.observability.flight_events}"
+            )
+        if cfg.observability.flight_sample_s <= 0:
+            raise ValueError(
+                "[observability] flight_sample_s must be > 0, got "
+                f"{cfg.observability.flight_sample_s}"
+            )
+        if cfg.observability.flight_spill_s <= 0:
+            raise ValueError(
+                "[observability] flight_spill_s must be > 0, got "
+                f"{cfg.observability.flight_spill_s}"
+            )
+        if cfg.observability.slow_command_us < 0:
+            raise ValueError(
+                "[observability] slow_command_us must be >= 0 (0 = off), "
+                f"got {cfg.observability.slow_command_us}"
+            )
         if cfg.observability.lag_ms_threshold <= 0:
             raise ValueError(
                 "[observability] lag_ms_threshold must be > 0, got "

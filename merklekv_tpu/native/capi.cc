@@ -4,8 +4,14 @@
 // server through these handles; buffers returned through out-params are
 // malloc'd here and released with mkv_free. Serialization formats are
 // little-endian length-prefixed, documented per function.
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <csignal>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <string>
 #include <vector>
 
@@ -556,6 +562,104 @@ int mkv_server_degradation(void* h) {
 // replication/WAL feed's backlog gauge.
 long long mkv_server_events_depth(void* h) {
   return (long long)static_cast<ServerHandle*>(h)->server->events().size();
+}
+
+// Slow-command log threshold in microseconds (0 = off). Dispatches at or
+// past it are recorded in the native flight log (FLIGHT fallback) and
+// relayed to the control plane as SLOWCMD notifications.
+void mkv_server_set_slow_threshold(void* h, long long us) {
+  static_cast<ServerHandle*>(h)->server->set_slow_threshold_us(
+      us < 0 ? 0 : uint64_t(us));
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------- crash marker
+//
+// Fatal-signal black-box stamp: a SIGSEGV/SIGABRT/SIGBUS appends ONE
+// line — "fatal signal <n> pid <p> wall_ns <t>" — to a pre-registered
+// file using only async-signal-safe calls (open/write/close, manual
+// decimal formatting), then restores the previously installed handler
+// (Python's faulthandler, when the control plane armed it first) and
+// re-raises, so traceback dumping and the default death both still
+// happen. The periodic flight spill holds the rich history; this marker
+// records WHAT killed the process and WHEN, which the spill — last
+// rewritten up to a spill interval earlier — cannot.
+
+namespace {
+
+char g_crash_path[512] = {0};
+struct sigaction g_crash_prev[32];
+const int g_crash_sigs[] = {SIGSEGV, SIGABRT, SIGBUS, SIGFPE};
+
+void crash_put_u64(char* buf, size_t cap, size_t* n, unsigned long long v) {
+  char tmp[24];
+  int i = 0;
+  if (v == 0) tmp[i++] = '0';
+  while (v && i < int(sizeof(tmp))) {
+    tmp[i++] = char('0' + v % 10);
+    v /= 10;
+  }
+  while (i > 0 && *n < cap - 1) buf[(*n)++] = tmp[--i];
+}
+
+void crash_put_str(char* buf, size_t cap, size_t* n, const char* s) {
+  while (*s && *n < cap - 1) buf[(*n)++] = *s++;
+}
+
+void crash_marker_handler(int sig) {
+  int fd = ::open(g_crash_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd >= 0) {
+    char buf[160];
+    size_t n = 0;
+    struct timespec ts {};
+    ::clock_gettime(CLOCK_REALTIME, &ts);
+    crash_put_str(buf, sizeof(buf), &n, "fatal signal ");
+    crash_put_u64(buf, sizeof(buf), &n, (unsigned long long)sig);
+    crash_put_str(buf, sizeof(buf), &n, " pid ");
+    crash_put_u64(buf, sizeof(buf), &n, (unsigned long long)::getpid());
+    crash_put_str(buf, sizeof(buf), &n, " wall_ns ");
+    crash_put_u64(buf, sizeof(buf), &n,
+                  (unsigned long long)ts.tv_sec * 1000000000ull +
+                      (unsigned long long)ts.tv_nsec);
+    crash_put_str(buf, sizeof(buf), &n, "\n");
+    ssize_t w = ::write(fd, buf, n);
+    (void)w;
+    ::close(fd);
+  }
+  // Chain: restore whatever handler was installed before ours (Python's
+  // faulthandler dumps tracebacks, else the default disposition kills the
+  // process) and re-deliver.
+  if (sig >= 0 && sig < int(sizeof(g_crash_prev) / sizeof(g_crash_prev[0]))) {
+    ::sigaction(sig, &g_crash_prev[sig], nullptr);
+  }
+  ::raise(sig);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Register the crash-marker path and install the fatal-signal handlers.
+// Call AFTER faulthandler.enable() so the marker chains into it. Empty
+// path is a no-op; calling again just updates the path.
+void mkv_install_crash_marker(const char* path) {
+  if (!path || !*path) return;
+  bool installed = g_crash_path[0] != 0;
+  std::snprintf(g_crash_path, sizeof(g_crash_path), "%s", path);
+  if (installed) return;
+  struct sigaction sa {};
+  sa.sa_handler = crash_marker_handler;
+  // SA_ONSTACK: faulthandler (installed first) registered an alternate
+  // signal stack; running the marker on it keeps stack-overflow SIGSEGVs
+  // — a death class the black box exists for — deliverable. Without it
+  // the kernel cannot push a frame onto the exhausted stack and forces
+  // the default disposition: no marker, no chained traceback.
+  sa.sa_flags = SA_ONSTACK;
+  sigemptyset(&sa.sa_mask);
+  for (int sig : g_crash_sigs) {
+    ::sigaction(sig, &sa, &g_crash_prev[sig]);
+  }
 }
 
 }  // extern "C"

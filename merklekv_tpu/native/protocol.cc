@@ -218,6 +218,11 @@ ParseResult parse_command(const std::string& line) {
       c.amount = 8;  // bare TRACE: a useful default window
       return ok(std::move(c));
     }
+    if (u == "FLIGHT") {
+      c.verb = Verb::Flight;
+      c.amount = 64;  // bare FLIGHT: a useful default window
+      return ok(std::move(c));
+    }
     if (u == "CLIENT") { c.verb = Verb::ClientList; return ok(std::move(c)); }
     if (u == "PING") { c.verb = Verb::Ping; return ok(std::move(c)); }
     if (u == "SHUTDOWN") { c.verb = Verb::Shutdown; return ok(std::move(c)); }
@@ -508,6 +513,18 @@ ParseResult parse_command(const std::string& line) {
     }
     Command c;
     c.verb = Verb::TraceDump;
+    c.amount = n;
+    return ok(std::move(c));
+  }
+  if (u == "FLIGHT") {
+    // "FLIGHT <n>" — newest n flight-recorder events.
+    auto toks = split_ws(rest);
+    int64_t n = 0;
+    if (toks.size() != 1 || !parse_i64_str(toks[0], &n) || n <= 0) {
+      return err("FLIGHT accepts one positive integer count");
+    }
+    Command c;
+    c.verb = Verb::Flight;
     c.amount = n;
     return ok(std::move(c));
   }

@@ -60,6 +60,13 @@ enum class Verb {
   // capture); answers the capture directory immediately, the capture stops
   // itself after <secs>. Without a cluster plane (or without jax): ERROR.
   Profile,
+  // Extension: "FLIGHT [n]" streams the newest n flight-recorder events
+  // (state transitions + slow commands) as k=v rows — the live view of the
+  // always-on black box (obs/flightrec.py). The control plane serves its
+  // full event ring; a bare native node falls back to its own slow-command
+  // log. Stays open through LOADING and every degradation rung: forensics
+  // must work exactly when the node is sick.
+  Flight,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };

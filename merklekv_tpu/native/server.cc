@@ -45,6 +45,49 @@ const char* traced_verb_name(Verb v) {
   }
 }
 
+// Full verb-name map for the slow-command log (every verb can be slow).
+const char* verb_name(Verb v) {
+  switch (v) {
+    case Verb::Get: return "GET";
+    case Verb::Set: return "SET";
+    case Verb::Delete: return "DELETE";
+    case Verb::Increment: return "INC";
+    case Verb::Decrement: return "DEC";
+    case Verb::Append: return "APPEND";
+    case Verb::Prepend: return "PREPEND";
+    case Verb::MultiGet: return "MGET";
+    case Verb::MultiSet: return "MSET";
+    case Verb::Truncate: return "TRUNCATE";
+    case Verb::Exists: return "EXISTS";
+    case Verb::Scan: return "SCAN";
+    case Verb::Dbsize: return "DBSIZE";
+    case Verb::Hash: return "HASH";
+    case Verb::LeafHashes: return "LEAFHASHES";
+    case Verb::Stats: return "STATS";
+    case Verb::Info: return "INFO";
+    case Verb::Version: return "VERSION";
+    case Verb::Memory: return "MEMORY";
+    case Verb::ClientList: return "CLIENT";
+    case Verb::Flushdb: return "FLUSHDB";
+    case Verb::Shutdown: return "SHUTDOWN";
+    case Verb::Ping: return "PING";
+    case Verb::Echo: return "ECHO";
+    case Verb::Sync: return "SYNC";
+    case Verb::Replicate: return "REPLICATE";
+    case Verb::HashPage: return "HASHPAGE";
+    case Verb::TreeLevel: return "TREELEVEL";
+    case Verb::Peers: return "PEERS";
+    case Verb::Metrics: return "METRICS";
+    case Verb::Trace: return "TRACE";
+    case Verb::SnapMeta: return "SNAPMETA";
+    case Verb::SnapChunk: return "SNAPCHUNK";
+    case Verb::TraceDump: return "TRACEDUMP";
+    case Verb::Profile: return "PROFILE";
+    case Verb::Flight: return "FLIGHT";
+  }
+  return "CMD";
+}
+
 // Blocking write for the accept-loop admission answers only (the fd is
 // still blocking there; worker-owned sockets flush through OutQueue).
 bool send_all(int fd, const std::string& data) {
@@ -721,6 +764,9 @@ std::string Server::stats_text() {
   add("events_queue_depth", events_.size());
   add("events_dropped", events_.dropped());
   add("degradation", degradation_.load(std::memory_order_acquire));
+  // Flight recorder: lifetime count of dispatches past the slow-command
+  // threshold (the log itself streams via FLIGHT).
+  add("slow_commands", flight_.total());
   add("busy_rejected_connections", ld(stats_.busy_rejected_connections));
   add("pipeline_rejected", ld(stats_.pipeline_rejected));
   add("shed_commands", ld(stats_.shed_commands));
@@ -772,20 +818,47 @@ void Server::run_command(const std::string& line,
   // measures the overhead; set_latency_enabled is the A/B switch).
   const bool timed = latency_enabled_.load(std::memory_order_acquire);
   const bool traced = !parsed.cmd.trace.empty();
-  const auto t0 = (timed || traced)
-                      ? std::chrono::steady_clock::now()
-                      : std::chrono::steady_clock::time_point{};
+  // Slow-command log: one relaxed load on the hot path; everything past
+  // the threshold comparison happens only for commands that ARE slow.
+  const uint64_t slow_us =
+      slow_threshold_us_.load(std::memory_order_relaxed);
+  const bool want_clock = timed || traced || slow_us > 0;
+  const auto t0 = want_clock ? std::chrono::steady_clock::now()
+                             : std::chrono::steady_clock::time_point{};
   // Wall-clock start rides with the TRACESPAN notification so the
   // collector can place the donor span on the initiator's timeline
   // (cross-node skew is the usual Dapper caveat, documented).
   const uint64_t wall0 = traced ? unix_now_ns() : 0;
   dispatch(parsed.cmd, out, close_conn);
-  if (timed || traced) {
+  if (want_clock) {
     const uint64_t dur_ns = uint64_t(
         std::chrono::duration_cast<std::chrono::nanoseconds>(
             std::chrono::steady_clock::now() - t0)
             .count());
     if (timed) stats_.latency.observe_ns(dur_ns);
+    if (slow_us > 0 && dur_ns / 1000 >= slow_us) {
+      // Record verb/latency/connection in the native flight log, and
+      // relay to the control plane (when attached) so the Python flight
+      // ring carries the same record on the node's merged timeline.
+      const uint64_t dur_us = dur_ns / 1000;
+      const char* vn = verb_name(parsed.cmd.verb);
+      flight_.record(vn, meta->addr, unix_now_ns() - dur_ns, dur_us);
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        // A traced serve relays its tc= token too: the control plane
+        // stamps the trace id on the flight event, which is what lets
+        // the blackbox analyzer link a donor's slow serve to the
+        // initiator's cycle across two nodes' spills.
+        std::string line = std::string("SLOWCMD ") + vn + " " +
+                           std::to_string(dur_us) + " " + meta->addr;
+        if (traced) line += " " + parsed.cmd.trace;
+        cb(line);
+      }
+    }
     if (traced) {
       // Fire-and-forget span notification to the control plane: only
       // traced cluster verbs pay this (a handful per sync cycle, never
@@ -1014,6 +1087,27 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
         }
       }
       out.lit("SPANS 0\r\nEND\r\n");
+      return;
+    }
+    case Verb::Flight: {
+      // Flight-recorder stream: the control plane serves its full event
+      // ring (state transitions + slow commands relayed via SLOWCMD); a
+      // bare native node still answers from its own slow-command log —
+      // the black box must answer even with no Python attached.
+      const int64_t n = cmd.amount.value_or(64);
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp = cb("FLIGHT " + std::to_string(n));
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
+      }
+      out.payload(flight_.wire_dump(size_t(n)));
       return;
     }
     case Verb::Profile: {

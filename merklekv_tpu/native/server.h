@@ -34,6 +34,7 @@
 #include <atomic>
 #include <chrono>
 #include <cstdint>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
@@ -135,6 +136,65 @@ struct IoWorkerStats {
   std::atomic<uint64_t> wakeups{0};       // epoll_wait returns with events
   std::atomic<uint64_t> writev_calls{0};  // flush syscalls
   std::atomic<uint64_t> writev_bytes{0};  // bytes those syscalls moved
+};
+
+// Slow-command log (the native half of the flight recorder): dispatch
+// records verb/latency/connection for every command whose duration
+// crosses the configured threshold ([observability] slow_command_us).
+// Bounded ring under a mutex — only SLOW commands pay the lock, so the
+// hot path's cost is one relaxed atomic load + the steady_clock reads it
+// already does for the latency histogram. Drained by the FLIGHT verb
+// (bare-node fallback; with a control plane attached the same records
+// also reach the Python flight ring via SLOWCMD notifications) and
+// hammered concurrently in tsan_stress.cc.
+struct FlightSlowEntry {
+  uint64_t seq;
+  uint64_t wall_ns;  // wall clock at command START (completion - duration)
+  uint64_t dur_us;
+  std::string verb;
+  std::string addr;
+};
+
+class FlightLog {
+ public:
+  static constexpr size_t kCap = 256;
+
+  void record(const char* verb, const std::string& addr, uint64_t wall_ns,
+              uint64_t dur_us) {
+    std::lock_guard lk(mu_);
+    ++total_;
+    entries_.push_back({total_, wall_ns, dur_us, verb, addr});
+    if (entries_.size() > kCap) entries_.pop_front();
+  }
+
+  uint64_t total() const {
+    std::lock_guard lk(mu_);
+    return total_;
+  }
+
+  // FLIGHT fallback response: "EVENTS <rows>" + one k=v row per entry,
+  // newest first, closed by END — the same table shape the Python flight
+  // ring serves, so one client parser covers both.
+  std::string wire_dump(size_t n) const {
+    std::lock_guard lk(mu_);
+    size_t count = entries_.size() < n ? entries_.size() : n;
+    std::string out = "EVENTS " + std::to_string(count) + "\r\n";
+    for (size_t i = 0; i < count; ++i) {
+      const FlightSlowEntry& e = entries_[entries_.size() - 1 - i];
+      out += "seq=" + std::to_string(e.seq) +
+             " wall_ns=" + std::to_string(e.wall_ns) +
+             " kind=slow_command verb=" + e.verb +
+             " dur_us=" + std::to_string(e.dur_us) + " conn=" + e.addr +
+             "\r\n";
+    }
+    out += "END\r\n";
+    return out;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::deque<FlightSlowEntry> entries_;
+  uint64_t total_ = 0;
 };
 
 // Node-wide degradation ladder (overload protection): each rung sheds a
@@ -248,6 +308,16 @@ class Server {
   int degradation() const {
     return degradation_.load(std::memory_order_acquire);
   }
+  // Slow-command threshold in MICROSECONDS (0 = off, the default): a
+  // dispatch taking at least this long is recorded in the flight log and
+  // relayed to the control plane as a SLOWCMD notification. The load is
+  // one relaxed atomic on the request path; everything else happens only
+  // for slow commands.
+  void set_slow_threshold_us(uint64_t us) {
+    slow_threshold_us_.store(us, std::memory_order_relaxed);
+  }
+  // FLIGHT's bare-node fallback body (also the tsan stress drain target).
+  std::string flight_text(size_t n) { return flight_.wire_dump(n); }
   // STATS body shared by the wire verb and the C API bridge: the counter
   // block plus the server-scope extension lines (event-queue depth/drops,
   // engine tombstone evictions, the degradation level and its shed
@@ -288,6 +358,8 @@ class Server {
   std::atomic<size_t> max_pipeline_{0};
   std::atomic<int> degradation_{0};     // Degradation enum value
   std::atomic<int> degrade_reason_{0};  // DegradeReason enum value
+  std::atomic<uint64_t> slow_threshold_us_{0};  // 0 = slow log off
+  FlightLog flight_;
   static constexpr size_t kWriteStripes = 64;
   std::mutex write_stripes_[kWriteStripes];
   std::atomic<int> listen_fd_{-1};

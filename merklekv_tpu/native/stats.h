@@ -134,6 +134,7 @@ struct ServerStats {
       case Verb::Trace: management_commands++; break;
       case Verb::TraceDump: management_commands++; break;
       case Verb::Profile: management_commands++; break;
+      case Verb::Flight: management_commands++; break;
       case Verb::Sync:
       case Verb::SnapMeta:
       case Verb::SnapChunk: sync_commands++; break;

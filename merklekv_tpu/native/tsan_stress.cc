@@ -208,10 +208,21 @@ void stress_pipelined_pool() {
     std::exit(1);
   }
   server.set_events_enabled(true);
+  // Flight-recorder stress: a 1 us slow threshold makes essentially EVERY
+  // dispatch record into the slow-command ring from all 4 io workers,
+  // while a drain thread concurrently renders FLIGHT dumps — the exact
+  // writer/reader overlap the FLIGHT verb produces in production.
+  server.set_slow_threshold_us(1);
   std::atomic<bool> draining{true};
   std::thread drainer([&] {
     while (draining.load(std::memory_order_acquire)) {
       server.events().drain(512);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  std::thread flight_drainer([&] {
+    while (draining.load(std::memory_order_acquire)) {
+      server.flight_text(64);
       std::this_thread::sleep_for(std::chrono::milliseconds(1));
     }
   });
@@ -223,6 +234,7 @@ void stress_pipelined_pool() {
   for (auto& t : clients) t.join();
   draining.store(false, std::memory_order_release);
   drainer.join();
+  flight_drainer.join();
   server.stop();
   server.wait();
 }

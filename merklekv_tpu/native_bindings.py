@@ -63,6 +63,16 @@ def server_binary() -> str:
     return _SERVER_BIN
 
 
+def install_crash_marker(path: str) -> None:
+    """Arm the native fatal-signal crash marker: a SIGSEGV/SIGABRT/SIGBUS/
+    SIGFPE appends one ``fatal signal <n> pid <p> wall_ns <t>`` line to
+    ``path`` (async-signal-safe), then chains to the previously installed
+    handler — call AFTER ``faulthandler.enable`` so Python tracebacks
+    still dump. Part of the flight-recorder fatal-dump plane
+    (docs/OBSERVABILITY.md "Post-mortem forensics")."""
+    _load().mkv_install_crash_marker(path.encode())
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
@@ -182,6 +192,10 @@ def _load() -> ctypes.CDLL:
     lib.mkv_server_degradation.argtypes = [ctypes.c_void_p]
     lib.mkv_server_events_depth.restype = ctypes.c_longlong
     lib.mkv_server_events_depth.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_set_slow_threshold.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong,
+    ]
+    lib.mkv_install_crash_marker.argtypes = [ctypes.c_char_p]
     lib.mkv_server_drain_events.argtypes = [
         ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
     ]
@@ -659,6 +673,15 @@ class NativeServer:
         if not self._h:
             return 0
         return int(self._lib.mkv_server_events_depth(self._h))
+
+    def set_slow_threshold(self, us: int) -> None:
+        """Slow-command log threshold in microseconds (0 = off): a
+        dispatch taking at least this long is recorded in the native
+        flight log (served by the FLIGHT verb on bare nodes) and relayed
+        to the control plane as a SLOWCMD notification so the Python
+        flight ring carries it too."""
+        if self._h:
+            self._lib.mkv_server_set_slow_threshold(self._h, us)
 
     def drain_events(self, max_events: int = 0) -> list[ChangeEventRaw]:
         out = ctypes.c_void_p()

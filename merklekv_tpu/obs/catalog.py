@@ -141,6 +141,15 @@ CATALOG: dict[str, tuple[str, str]] = {
         "histogram", "Structural-batch dispatch (async enqueue) latency."),
     "profiler.captures": (
         "counter", "PROFILE verb device-profiler captures started."),
+    # -- flight recorder ---------------------------------------------------
+    "flight.spills": (
+        "counter", "Flight-recorder spill files rewritten (atomic "
+        "tmp+rename under [observability] flight_dir)."),
+    "flight.spill_errors": (
+        "counter", "Spill rewrites that failed (full/unwritable disk; the "
+        "previous complete spill stays valid)."),
+    "flight.sample_errors": (
+        "counter", "Flight metric-sampler ticks that raised internally."),
     # -- bootstrap ---------------------------------------------------------
     "bootstrap.bytes_fetched": (
         "counter", "Raw snapshot bytes fetched by the joiner."),

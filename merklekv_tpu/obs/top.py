@@ -13,6 +13,11 @@ busiest io worker's command rate, the pool-imbalance signal.)
 ``--once`` prints a single frame (two quick samples for rates) and exits —
 scriptable and testable; without it the screen refreshes every
 ``--interval`` seconds until Ctrl-C.
+
+``--events`` appends a flight-recorder pane: the newest black-box events
+(degradation flips, slow commands, sync failures, peer flips) across the
+polled nodes, fetched via the FLIGHT verb — the live view of what
+``python -m merklekv_tpu blackbox`` reads post-mortem.
 """
 
 from __future__ import annotations
@@ -64,6 +69,10 @@ class NodeSample:
     # the worker pool).
     io_threads: int = 0
     worker_commands: dict = field(default_factory=dict)
+    # Flight-recorder pane (--events): newest black-box events via the
+    # FLIGHT verb, one dict per event ([] on nodes predating the verb or
+    # when --events is off).
+    events: list = field(default_factory=list)
 
 
 def _p50_from_stats(stats: dict[str, str]) -> Optional[float]:
@@ -94,7 +103,9 @@ def _p50_from_stats(stats: dict[str, str]) -> Optional[float]:
     return None
 
 
-def sample_node(node: str, timeout: float = 2.0) -> NodeSample:
+def sample_node(
+    node: str, timeout: float = 2.0, events_n: int = 0
+) -> NodeSample:
     host, _, port = node.rpartition(":")
     s = NodeSample(node=node)
     try:
@@ -103,6 +114,11 @@ def sample_node(node: str, timeout: float = 2.0) -> NodeSample:
             info = c.info()
             metrics = c.metrics()
             peers = c.peers()
+            if events_n > 0:
+                try:
+                    s.events = c.flight(events_n)
+                except MerkleKVError:
+                    s.events = []  # node predates the FLIGHT verb
     except (MerkleKVError, OSError, ValueError) as e:
         s.error = f"{type(e).__name__}: {e}"
         return s
@@ -156,6 +172,37 @@ def sample_node(node: str, timeout: float = 2.0) -> NodeSample:
 
 def _rate(cur: int, prev: int, dt: float) -> float:
     return max(0.0, (cur - prev) / dt) if dt > 0 else 0.0
+
+
+def render_events_pane(cur: dict[str, NodeSample]) -> str:
+    """Bottom pane (--events): the newest flight-recorder events across
+    the polled nodes — degradation flips, slow commands, sync failures —
+    newest last, so the eye lands on the most recent transition."""
+    rows: list[tuple[int, str]] = []
+    now_ns = time.time_ns()
+    for node, s in cur.items():
+        for ev in s.events:
+            try:
+                wall = int(ev.get("wall_ns", 0))
+            except ValueError:
+                wall = 0
+            age = max(0.0, (now_ns - wall) / 1e9) if wall else -1.0
+            detail = " ".join(
+                f"{k}={v}"
+                for k, v in ev.items()
+                if k not in ("seq", "wall_ns", "kind", "trace")
+            )
+            kind = ev.get("kind", "?")
+            age_s = f"{age:8.1f}s" if age >= 0 else "       -"
+            rows.append(
+                (wall, f"{age_s}  {node:<22} {kind:<18} {detail}")
+            )
+    rows.sort(key=lambda r: r[0])
+    header = f"{'AGE':>9}  {'NODE':<22} {'EVENT':<18} DETAIL"
+    return "\n".join(
+        ["", "-- flight events " + "-" * 46, header]
+        + [line for _, line in rows]
+    )
 
 
 def render_table(
@@ -228,14 +275,31 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="print one frame (two samples, interval apart) and exit",
     )
     p.add_argument("--timeout", type=float, default=2.0)
+    p.add_argument(
+        "--events",
+        action="store_true",
+        help="append a flight-recorder pane (newest black-box events "
+        "across the nodes, via the FLIGHT verb)",
+    )
+    p.add_argument(
+        "--events-n",
+        type=int,
+        default=8,
+        help="events fetched per node for the --events pane",
+    )
     args = p.parse_args(argv)
     nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
     if not nodes:
         print("no nodes given", file=sys.stderr)
         return 2
 
+    events_n = max(1, args.events_n) if args.events else 0
+
     def take() -> dict[str, NodeSample]:
-        return {n: sample_node(n, timeout=args.timeout) for n in nodes}
+        return {
+            n: sample_node(n, timeout=args.timeout, events_n=events_n)
+            for n in nodes
+        }
 
     prev = take()
     try:
@@ -243,6 +307,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             time.sleep(max(0.05, args.interval))
             cur = take()
             frame = render_table(prev, cur)
+            if args.events:
+                frame += render_events_pane(cur)
             if args.once:
                 print(frame, flush=True)
                 return 0

@@ -83,6 +83,11 @@ class CycleTrace:
     started_unix: float = field(default_factory=time.time)
     seconds: float = 0.0
     peers: list[PeerTrace] = field(default_factory=list)
+    # Causal trace id of the cycle (0 = untraced). Carried explicitly
+    # because the summary is appended AFTER the cycle's trace scope has
+    # exited — the flight recorder stamps it so a donor's traced serves
+    # and the initiator's sync_cycle event join across nodes' spills.
+    trace_id: int = 0
 
 
 class SyncTraceBuffer:
@@ -104,6 +109,32 @@ class SyncTraceBuffer:
             self._cycles.append(cycle)
             if len(self._cycles) > self._capacity:
                 del self._cycles[: len(self._cycles) - self._capacity]
+        # Flight recorder: every anti-entropy cycle outcome lands on the
+        # black-box timeline (the worst peer outcome is the headline; the
+        # TRACE ring keeps the full per-peer detail).
+        try:
+            from merklekv_tpu.obs.flightrec import record
+
+            rank = {"error": 4, "degraded": 3, "skipped": 2, "ok": 1,
+                    "noop": 0}
+            worst = max(
+                (p.outcome for p in cycle.peers),
+                key=lambda o: rank.get(o, 0),
+                default="noop",
+            )
+            fields = dict(
+                cycle=cycle.cycle_id,
+                mode=cycle.kind,
+                peers=len(cycle.peers),
+                outcome=worst,
+                repairs=sum(p.repairs for p in cycle.peers),
+                seconds=round(cycle.seconds, 4),
+            )
+            if cycle.trace_id:
+                fields["trace"] = f"{cycle.trace_id:016x}"
+            record("sync_cycle", **fields)
+        except Exception:
+            pass  # the trace ring must never fail on recorder trouble
 
     def last(self, n: int) -> list[CycleTrace]:
         """Newest ``n`` cycles, newest first."""

@@ -147,6 +147,13 @@ class trace_scope:
         self._ctx = ctx
         self._token = None
 
+    @property
+    def ctx(self) -> TraceContext:
+        """The scope's context, readable before/after the block — callers
+        that summarize AFTER __exit__ (the sync cycle's finally) take the
+        trace id from here rather than the already-reset contextvar."""
+        return self._ctx
+
     def __enter__(self) -> TraceContext:
         self._token = _current.set(self._ctx)
         return self._ctx

@@ -461,6 +461,9 @@ class DurableStore:
         if not self._full:
             self._full = True
             self._full_reason = str(cause)
+            from merklekv_tpu.obs.flightrec import record
+
+            record("storage_full", reason=str(cause)[:120])
             now = time.monotonic()
             if now - self._recovered_at_m < 10.0:
                 # Re-latched right after a probe recovery: the probe lied
@@ -549,6 +552,9 @@ class DurableStore:
         self._recovered_at_m = time.monotonic()
         self._snapshot_requested = True  # re-anchor: close the journal gap
         get_metrics().inc("storage.full_recoveries")
+        from merklekv_tpu.obs.flightrec import record
+
+        record("storage_recovered")
         import sys
 
         print(
@@ -726,9 +732,18 @@ class DurableStore:
                 root,
             )
             self._bytes_since_snapshot = 0
-            # A whole snapshot fit on disk: genuine room, stop backing off.
+            # A whole snapshot fit on disk: genuine room, stop backing off —
+            # including the flap DETECTOR. _note_full arms the probe backoff
+            # whenever a latch lands within 10 s of a recovery; without
+            # clearing the recovery stamp here, a completed re-anchor
+            # snapshot (the documented backoff reset) still left the next
+            # genuine full episode tarred as a flap, deferring its recovery
+            # probe by the minimum 2 s — which is exactly what made
+            # test_soak_repeated_disk_full_cycles fail its post-heal
+            # storage_full assertion on every cycle after the first.
             self._probe_backoff_s = 0.0
             self._next_probe_m = 0.0
+            self._recovered_at_m = 0.0
             seconds = time.perf_counter() - t0
             out["items"] = len(items)
             out["root"] = root[:16]
