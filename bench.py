@@ -38,7 +38,12 @@ The remaining BASELINE.json configs print one JSON line each on STDERR
   - flight_overhead_pct: flight-recorder A/B — throughput cost of the
     always-on black box (slow-command threshold + 1 s metric sampler +
     periodic spill) under the pipelined many-connection load; down-good,
-    acceptance bar < 5%.
+    acceptance bar < 5%;
+  - tree_freshness_write_p99_us: asynchronous Merkle maintenance A/B —
+    SET p99 under a concurrent TREELEVEL/HASH query load, pump-published
+    snapshot vs force-on-query vs tree-maintenance-off, with the measured
+    max staleness vs the [device] window and a bit-identical root check
+    once the window closes; down-good.
 
 Off-TPU the sizes shrink to smoke-test values so the script stays runnable
 in CI; the driver's real run happens on the chip.
@@ -779,6 +784,243 @@ def bench_many_conn_throughput(
     }
 
 
+def bench_tree_freshness_write_storm(duration_s: float = 1.2) -> dict:
+    """Asynchronous Merkle maintenance A/B (bounded-staleness device pump).
+
+    One node with the device mirror + update pump live takes a write storm
+    CONCURRENT with a TREELEVEL/HASH query load, in three phases:
+
+      - ``off``:   tree maintenance off entirely (bare native server, no
+                   event staging) — the write-p99 floor;
+      - ``force``: every query carries vs=03, i.e. the OLD force-on-query
+                   discipline (replicator flush + synchronous pump drain
+                   per root-serving query — the serialization this issue
+                   removes);
+      - ``pump``:  plain queries served from the pump's last-published
+                   snapshot (the new default path).
+
+    Reported: per-SET round-trip p99 per phase (value = pump-phase p99,
+    ``_us`` so tools/bench_gate.py reads it down-good), the max observed
+    pump lag during the pump phase vs the configured window, and whether
+    the served root converges BIT-IDENTICALLY to the engine root once the
+    window closes. Acceptance: pump p99 within 10% of off (plus a small
+    absolute floor for CI jitter) while staleness stays inside the
+    window."""
+    import subprocess
+    import threading
+    import uuid as _uuid
+
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.cluster.transport import TcpBroker
+    from merklekv_tpu.config import Config
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    window_ms = 200.0
+    n_keys = 512
+    val = "x" * 64
+
+    # The writer runs OUT of process: the pump/replicator/querier threads
+    # share this interpreter's GIL, and an in-process writer would measure
+    # GIL contention instead of the write path (which is pure native C++
+    # on the server side — the whole point of the pump is that writes
+    # never touch the device plane).
+    writer_src = (
+        "import json, sys, time\n"
+        "from merklekv_tpu.client import MerkleKVClient\n"
+        "host, port = sys.argv[1], int(sys.argv[2])\n"
+        "dur, n_keys = float(sys.argv[3]), int(sys.argv[4])\n"
+        "val = 'x' * 64\n"
+        "lats = []\n"
+        "with MerkleKVClient(host, port, timeout=10.0) as c:\n"
+        "    stop = time.perf_counter() + dur\n"
+        "    i = 0\n"
+        "    while time.perf_counter() < stop:\n"
+        "        t0 = time.perf_counter_ns()\n"
+        "        c.set('tf:%05d' % (i % n_keys), val)\n"
+        "        lats.append(time.perf_counter_ns() - t0)\n"
+        "        i += 1\n"
+        "s = sorted(lats)\n"
+        "print(json.dumps({'n': len(s),\n"
+        "    'p99_us': s[min(int(0.99 * (len(s) - 1)), len(s) - 1)] / 1e3,\n"
+        "    'p50_us': s[len(s) // 2] / 1e3}))\n"
+    )
+
+    def run_phase(port: int, force: bool):
+        """Subprocess write storm + in-process query load against
+        ``port``; returns ({'n', 'p99_us', 'p50_us'}, queries served)."""
+        stop = threading.Event()
+        served = {"n": 0}
+
+        def querier() -> None:
+            try:
+                with MerkleKVClient("127.0.0.1", port, timeout=10.0) as qc:
+                    qc.version_stamps = True
+                    try:
+                        qc.tree_level(0, 0, 0)  # settle the capability
+                    except Exception:
+                        pass
+                    while not stop.is_set():
+                        try:
+                            qc.tree_level(0, 0, 8, force=force)
+                            if served["n"] % 8 == 0:
+                                qc.hash(force=force)
+                            served["n"] += 1
+                        except Exception:
+                            pass
+            except Exception:
+                pass
+
+        qt = threading.Thread(target=querier, daemon=True)
+        qt.start()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", writer_src, "127.0.0.1",
+                 str(port), str(duration_s), str(n_keys)],
+                capture_output=True, text=True,
+                timeout=60 + duration_s * 10,
+            )
+        finally:
+            stop.set()
+            qt.join(timeout=10)
+        if out.returncode != 0 or not out.stdout.strip():
+            raise RuntimeError(
+                f"writer subprocess failed (rc={out.returncode}): "
+                f"{out.stderr.strip()[-500:]}"
+            )
+        data = json.loads(out.stdout.strip().splitlines()[-1])
+        return data, served["n"]
+
+    # Phase OFF: bare native server, no cluster plane, no event staging —
+    # queries hit the host tree cache, writes pay nothing tree-shaped.
+    eng_off = NativeEngine("mem")
+    srv_off = NativeServer(eng_off, "127.0.0.1", 0)
+    srv_off.start()
+    try:
+        for i in range(n_keys):
+            eng_off.set(f"tf:{i:05d}".encode(), val.encode())
+        off_data, off_q = run_phase(srv_off.port, force=False)
+    finally:
+        srv_off.close()
+        eng_off.close()
+
+    # Phases FORCE / PUMP: one node with the mirror + pump live.
+    broker = TcpBroker()
+    engine = NativeEngine("mem")
+    server = NativeServer(engine, "127.0.0.1", 0)
+    server.start()
+    cfg = Config()
+    cfg.replication.enabled = True
+    cfg.replication.mqtt_broker = broker.host
+    cfg.replication.mqtt_port = broker.port
+    cfg.replication.topic_prefix = f"tf-{_uuid.uuid4().hex[:8]}"
+    cfg.replication.client_id = "tf-bench"
+    cfg.device.max_staleness_ms = window_ms
+    node = ClusterNode(cfg, engine, server)
+    node.start()
+    try:
+        with MerkleKVClient("127.0.0.1", server.port, timeout=30.0) as c:
+            # Seed BEFORE warming so the warm build covers the full
+            # keyspace (inserts after warm would pay restructure compiles
+            # inside the measured phases).
+            for base in range(0, n_keys, 64):
+                c.mset({
+                    f"tf:{i:05d}": val
+                    for i in range(base, min(base + 64, n_keys))
+                })
+            c.hash()  # trigger warming
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if node._mirror is not None and node._mirror.ready():
+                    break
+                time.sleep(0.05)
+            mirror = node._mirror
+            warmed = mirror is not None and mirror.ready()
+            # Shake out lazy kernel compiles: the first scatter dispatch of
+            # each batch-size bucket compiles for SECONDS (CPU jax) while
+            # holding the mirror lock — without this, compiles land inside
+            # the measured phases and read as pump-path latency.
+            if warmed:
+                for burst in (1, 8, 24, 60, 140, 300):
+                    c.mset({
+                        f"tf:{i:05d}": val + "w" for i in range(burst)
+                    })
+                    node.device_root_hex(force=True)
+
+        force_data, force_q = run_phase(server.port, force=True)
+
+        stale_samples: list[float] = []
+        stale_stop = threading.Event()
+
+        def stale_sampler() -> None:
+            while not stale_stop.is_set():
+                if warmed:
+                    stale_samples.append(mirror.pump_lag_ms())
+                time.sleep(0.01)
+
+        st = threading.Thread(target=stale_sampler, daemon=True)
+        st.start()
+        try:
+            pump_data, pump_q = run_phase(server.port, force=False)
+        finally:
+            stale_stop.set()
+            st.join(timeout=5)
+
+        # Window closes -> the served (unforced) root must be bit-identical
+        # to the engine root.
+        roots_match = False
+        deadline = time.time() + max(2.0, 10 * window_ms / 1000.0)
+        engine_root = engine.merkle_root().hex()
+        while time.time() < deadline and warmed:
+            if mirror.published_root_hex() == engine_root:
+                roots_match = True
+                break
+            time.sleep(0.02)
+
+        off_p99 = off_data["p99_us"]
+        force_p99 = force_data["p99_us"]
+        pump_p99 = pump_data["p99_us"]
+        stale_max = max(stale_samples) if stale_samples else 0.0
+        target = max(off_p99 * 1.10, off_p99 + 150.0)
+        return {
+            "metric": "tree_freshness_write_p99_us",
+            "value": round(pump_p99, 1),
+            "unit": "us (SET p99 under concurrent TREELEVEL load, "
+                    "pump path)",
+            "off_p99_us": round(off_p99, 1),
+            "force_p99_us": round(force_p99, 1),
+            "pump_p99_us": round(pump_p99, 1),
+            "off_p50_us": round(off_data["p50_us"], 1),
+            "force_p50_us": round(force_data["p50_us"], 1),
+            "pump_p50_us": round(pump_data["p50_us"], 1),
+            "pump_vs_off_pct": round(
+                (pump_p99 / off_p99 - 1.0) * 100.0, 1
+            ) if off_p99 else None,
+            "writes_off": off_data["n"],
+            "writes_force": force_data["n"],
+            "writes_pump": pump_data["n"],
+            "queries_off": off_q,
+            "queries_force": force_q,
+            "queries_pump": pump_q,
+            "staleness_max_ms": round(stale_max, 1),
+            "window_ms": window_ms,
+            "staleness_within_window": stale_max <= window_ms,
+            "roots_match_after_window": roots_match,
+            "mirror_warmed": warmed,
+            "target": round(target, 1),
+            "target_met": bool(
+                pump_p99 <= target
+                and roots_match
+                and stale_max <= window_ms
+            ),
+        }
+    finally:
+        node.stop()
+        server.close()
+        engine.close()
+        broker.close()
+
+
 def bench_flight_overhead(
     n_conns: int = 16, depth: int = 32, bursts: int = 20, rounds: int = 3
 ) -> dict:
@@ -1223,6 +1465,15 @@ def _run(backend: str) -> None:
         )
     except Exception as e:
         print(f"# flight_overhead bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(
+            bench_tree_freshness_write_storm(
+                duration_s=2.0 if on_tpu else 1.2
+            )
+        )
+    except Exception as e:
+        print(f"# tree_freshness_write_storm bench failed: {e!r}",
+              file=sys.stderr)
 
     # Every emitted record carries the run's metrics snapshot (counters +
     # span aggregates) so a BENCH_*.json trajectory shows what the run

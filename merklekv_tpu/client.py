@@ -148,6 +148,25 @@ def _count_after(resp: str, prefix: str) -> int:
     return int(resp[len(prefix):])
 
 
+def _parse_hashes_header(resp: str) -> tuple[int, Optional[int]]:
+    """``HASHES <count>`` or the stamped ``HASHES <count> <ver>`` form
+    (LEAFHASHES/HASHPAGE) -> (count, version stamp | None). Any other
+    shape raises — a truncated or garbled header must never be read as a
+    shorter page."""
+    resp = _parse_simple(resp)
+    if not resp.startswith("HASHES "):
+        raise ProtocolError(f"unexpected response: {resp}")
+    fields = resp[7:].split(" ")
+    try:
+        if len(fields) == 1:
+            return int(fields[0]), None
+        if len(fields) == 2:
+            return int(fields[0]), int(fields[1])
+    except ValueError as e:
+        raise ProtocolError(f"malformed HASHES header: {resp!r}") from e
+    raise ProtocolError(f"malformed HASHES header: {resp!r}")
+
+
 # Error-text signatures of a peer that cannot parse a trailing trace-context
 # token (pre-tracing version): its parser rejects the extra argument with
 # one of these arity complaints. The client then drops the token for the
@@ -216,6 +235,17 @@ class MerkleKVClient:
         # peer accepted a token; False = capability fallback engaged.
         self.trace_provider = None
         self._peer_traced: Optional[bool] = None
+        # Version-stamp negotiation (docs/PROTOCOL.md "Version-stamped tree
+        # answers"): when True, tree-serving verbs append a "vs=XX" token
+        # asking the server to stamp its reply with the engine version the
+        # served tree reflects. Same capability tri-state discipline as the
+        # trace token (an old peer's arity ERROR drops stamping for the
+        # connection); the parsed stamp of the LAST stamped answer lands in
+        # ``last_stamp`` as (version, lag) — lag 0 for live-engine verbs,
+        # and None when the answer carried no stamp.
+        self.version_stamps = False
+        self._peer_stamped: Optional[bool] = None
+        self.last_stamp: Optional[tuple[int, int]] = None
 
     # -- lifecycle ---------------------------------------------------------
     def connect(self) -> "MerkleKVClient":
@@ -290,27 +320,82 @@ class MerkleKVClient:
         except Exception:
             return None  # a broken provider must never fail the request
 
-    def _traced_request(self, line: str, require_settled: bool = False) -> str:
-        """Send a cluster verb with the active trace token appended; on an
-        arity ERROR (peer predates trace propagation) drop the token for
-        this connection and retry the plain form once.
+    def _version_token(
+        self, require_settled: bool, force: bool
+    ) -> Optional[str]:
+        """The vs= token to attach, or None. ``force=True`` is an EXPLICIT
+        exactness request, so it attaches even when stamping is off or
+        unsettled — dropping it silently would return a bounded-stale
+        answer where the caller asked for an exact one. (Against an old
+        server the fallback still engages: fail-closed verbs get the arity
+        ERROR, and bare HASH detects the token echoed back as a pattern —
+        either way the plain retry's answer is computed live, i.e. exact,
+        because pre-pump servers never serve stale.)"""
+        if self._peer_stamped is False:
+            return None
+        if force:
+            return "vs=03"
+        if not self.version_stamps:
+            return None
+        if require_settled and self._peer_stamped is not True:
+            return None
+        return "vs=01"
 
-        ``require_settled``: only attach the token once this connection
-        has PROVED the peer parses it (an earlier traced verb succeeded).
+    def _traced_request(
+        self,
+        line: str,
+        require_settled: bool = False,
+        stamp: bool = False,
+        force: bool = False,
+        trace: bool = True,
+    ) -> str:
+        """Send a cluster verb with the optional trailing tokens appended —
+        the version-stamp token (``stamp=True`` verbs only: HASH/TREELEVEL/
+        LEAFHASHES/HASHPAGE) first, the trace token last. On an arity ERROR
+        the tokens are dropped newest-capability-first for this connection
+        and the request retried: a peer one release back parses tc= but not
+        vs= (drop the stamp, keep the trace); an older peer rejects both
+        (two retries settle both tri-states False). Each ERROR answer is a
+        single line, so the stream stays in sync across retries.
+
+        ``require_settled``: only attach tokens once this connection has
+        PROVED the peer parses them (an earlier tokened verb succeeded).
         Verbs with OPTIONAL trailing arguments need this — an old peer
-        reads the token as that argument (LEAFHASHES: a prefix -> empty
+        reads a token as that argument (LEAFHASHES: a prefix -> empty
         hash set; HASHPAGE: the after-cursor -> a silently truncated
         page) instead of erroring. Fixed-arity verbs (TREELEVEL,
-        SNAPMETA, SNAPCHUNK) fail closed on the extra token and settle
-        capability safely."""
-        tok = self._trace_token()
-        if tok is None or (require_settled and self._peer_traced is not True):
+        SNAPMETA, SNAPCHUNK) fail closed on extra tokens and settle
+        capability safely. ``force`` rides the stamp token (vs=03): ask
+        the server for a fresh tree before answering."""
+        if stamp:
+            self.last_stamp = None
+        vtok = self._version_token(require_settled, force) if stamp else None
+        ttok = self._trace_token() if trace else None
+        if ttok is not None and require_settled and self._peer_traced is not True:
+            ttok = None
+        if vtok is None and ttok is None:
             return self._request(line)
-        resp = self._request(f"{line} {tok}")
+        suffix = (f" {vtok}" if vtok else "") + (f" {ttok}" if ttok else "")
+        resp = self._request(line + suffix)
         if resp.startswith("ERROR ") and _is_trace_capability_error(resp):
+            if vtok is not None:
+                self._peer_stamped = False
+                resp = self._request(line + (f" {ttok}" if ttok else ""))
+                if ttok is None:
+                    return resp
+                if resp.startswith("ERROR ") and _is_trace_capability_error(
+                    resp
+                ):
+                    self._peer_traced = False
+                    return self._request(line)
+                self._peer_traced = True
+                return resp
             self._peer_traced = False
             return self._request(line)
-        self._peer_traced = True
+        if vtok is not None:
+            self._peer_stamped = True
+        if ttok is not None:
+            self._peer_traced = True
         return resp
 
     def _read_body(self, n: int) -> list[str]:
@@ -389,12 +474,49 @@ class MerkleKVClient:
     def dbsize(self) -> int:
         return _count_after(self._request("DBSIZE"), "DBSIZE ")
 
-    def hash(self, pattern: Optional[str] = None) -> str:
-        cmd = "HASH" if pattern is None else f"HASH {pattern}"
-        resp = _parse_simple(self._request(cmd))
-        if not resp.startswith("HASH "):
+    def hash(self, pattern: Optional[str] = None, force: bool = False) -> str:
+        """Whole-keyspace (or prefix) Merkle root. With ``version_stamps``
+        on and the peer's capability settled, the bare form carries the
+        vs= token and the stamped answer's (version, lag) lands in
+        ``last_stamp`` — lag > 0 means the served root trails the live
+        engine by that many mutations (the bounded-staleness device tree).
+        ``force=True`` asks the server to refresh the tree first (exact
+        root; the snapshot-stamping escape hatch)."""
+        if pattern is not None:
+            resp = _parse_simple(self._request(f"HASH {pattern}"))
+            if not resp.startswith("HASH "):
+                raise ProtocolError(f"unexpected response: {resp}")
+            return resp.rsplit(" ", 1)[-1]
+        # require_settled: an old server reads the token as a PATTERN and
+        # answers the echoed-pattern wire shape — fail-open, so the stamp
+        # only attaches once a fail-closed verb proved the capability.
+        # trace=False: HASH never carried the tc= token (the server does
+        # not parse it there) — only the stamp token attaches.
+        resp = _parse_simple(
+            self._traced_request(
+                "HASH", require_settled=True, stamp=True, force=force,
+                trace=False,
+            )
+        )
+        fields = resp.split(" ")
+        if len(fields) == 3 and fields[1].startswith("vs="):
+            # Old server echoed the token back as a PATTERN ("HASH vs=03
+            # <hex>"): capability miss, settle and retry plain. The plain
+            # answer is computed live — pre-pump servers never serve
+            # stale — so a force intent is still honored.
+            self._peer_stamped = False
+            resp = _parse_simple(self._request("HASH"))
+            fields = resp.split(" ")
+        if fields[0] != "HASH" or len(fields) not in (2, 4):
             raise ProtocolError(f"unexpected response: {resp}")
-        return resp.rsplit(" ", 1)[-1]
+        if len(fields) == 4:
+            try:
+                self.last_stamp = (int(fields[2]), int(fields[3]))
+            except ValueError as e:
+                raise ProtocolError(
+                    f"malformed HASH stamp: {resp!r}"
+                ) from e
+        return fields[1]
 
     def leaf_hashes(self, prefix: str = "") -> dict[str, str]:
         """Per-key leaf digests (hex) of LIVE keys — the anti-entropy
@@ -413,9 +535,11 @@ class MerkleKVClient:
         field "-"). Servers that predate the ts field yield ts 0
         ("unknown age")."""
         cmd = f"LEAFHASHES {prefix}" if prefix else "LEAFHASHES"
-        n = _count_after(
-            self._traced_request(cmd, require_settled=True), "HASHES "
+        n, stamp = _parse_hashes_header(
+            self._traced_request(cmd, require_settled=True, stamp=True)
         )
+        if stamp is not None:
+            self.last_stamp = (stamp, 0)
         out: dict[str, tuple[Optional[str], int]] = {}
         for _ in range(n):
             parts = self._read_line().split(" ")
@@ -454,9 +578,11 @@ class MerkleKVClient:
         # require_settled: an old peer would read the token as the
         # after-cursor (or upto bound) and silently skip every key below
         # it — a fail-OPEN page truncation, never an ERROR.
-        n = _count_after(
-            self._traced_request(cmd, require_settled=True), "HASHES "
+        n, stamp = _parse_hashes_header(
+            self._traced_request(cmd, require_settled=True, stamp=True)
         )
+        if stamp is not None:
+            self.last_stamp = (stamp, 0)
         rows: list[tuple[str, Optional[str], int]] = []
         for _ in range(n):
             parts = self._read_line().split(" ")
@@ -481,7 +607,7 @@ class MerkleKVClient:
         return rows, n < count
 
     def tree_level(
-        self, level: int, lo: int, hi: int
+        self, level: int, lo: int, hi: int, force: bool = False
     ) -> tuple[list[tuple[int, str]], int]:
         """Interior digests of the server's reference Merkle tree
         (TREELEVEL): ``(idx, digest hex)`` rows for level ``level``
@@ -489,15 +615,25 @@ class MerkleKVClient:
         plus the live leaf count ``n`` (which fixes every level's size:
         ``m_0 = n``, ``m_{l+1} = (m_l + 1) // 2``). ``lo == hi`` is the
         zero-cost capability probe + leaf-count fetch the bisection walk
-        opens with."""
+        opens with. With ``version_stamps`` the stamped header's
+        (version, lag) lands in ``last_stamp``; ``force=True`` asks for a
+        freshly refreshed tree (the walk's staleness escalation)."""
         resp = _parse_simple(
-            self._traced_request(f"TREELEVEL {level} {lo} {hi}")
+            self._traced_request(
+                f"TREELEVEL {level} {lo} {hi}", stamp=True, force=force
+            )
         )
         if not resp.startswith("NODES "):
             raise ProtocolError(f"unexpected response: {resp}")
+        fields = resp[6:].split(" ")
         try:
-            count_s, n_s = resp[6:].split(" ")
-            count, n = int(count_s), int(n_s)
+            if len(fields) == 2:
+                count, n = int(fields[0]), int(fields[1])
+            elif len(fields) == 4:
+                count, n = int(fields[0]), int(fields[1])
+                self.last_stamp = (int(fields[2]), int(fields[3]))
+            else:
+                raise ValueError("NODES header must carry 2 or 4 fields")
         except ValueError as e:
             raise ProtocolError(f"unexpected response: {resp}") from e
         rows: list[tuple[int, str]] = []
@@ -726,6 +862,10 @@ class AsyncMerkleKVClient:
         # Causal-trace propagation, mirroring the sync client.
         self.trace_provider = None
         self._peer_traced: Optional[bool] = None
+        # Version-stamp negotiation, mirroring the sync client.
+        self.version_stamps = False
+        self._peer_stamped: Optional[bool] = None
+        self.last_stamp: Optional[tuple[int, int]] = None
 
     async def connect(self) -> "AsyncMerkleKVClient":
         try:
@@ -787,20 +927,64 @@ class AsyncMerkleKVClient:
         except Exception:
             return None
 
+    def _version_token(
+        self, require_settled: bool, force: bool
+    ) -> Optional[str]:
+        # Same rules as the sync client: force is an explicit exactness
+        # request and attaches even when stamping is off or unsettled.
+        if self._peer_stamped is False:
+            return None
+        if force:
+            return "vs=03"
+        if not self.version_stamps:
+            return None
+        if require_settled and self._peer_stamped is not True:
+            return None
+        return "vs=01"
+
     async def _traced_request(
-        self, line: str, require_settled: bool = False
+        self,
+        line: str,
+        require_settled: bool = False,
+        stamp: bool = False,
+        force: bool = False,
+        trace: bool = True,
     ) -> str:
         """Async twin of the sync client's ``_traced_request``: same token
-        append, same capability fallback on an arity ERROR, same
-        settled-capability rule for optional-trailing-argument verbs."""
-        tok = self._trace_token()
-        if tok is None or (require_settled and self._peer_traced is not True):
+        append (version stamp first, trace last), same newest-capability-
+        first fallback on an arity ERROR, same settled-capability rule for
+        optional-trailing-argument verbs."""
+        if stamp:
+            self.last_stamp = None
+        vtok = self._version_token(require_settled, force) if stamp else None
+        ttok = self._trace_token() if trace else None
+        if ttok is not None and require_settled and self._peer_traced is not True:
+            ttok = None
+        if vtok is None and ttok is None:
             return await self._request(line)
-        resp = await self._request(f"{line} {tok}")
+        suffix = (f" {vtok}" if vtok else "") + (f" {ttok}" if ttok else "")
+        resp = await self._request(line + suffix)
         if resp.startswith("ERROR ") and _is_trace_capability_error(resp):
+            if vtok is not None:
+                self._peer_stamped = False
+                resp = await self._request(
+                    line + (f" {ttok}" if ttok else "")
+                )
+                if ttok is None:
+                    return resp
+                if resp.startswith("ERROR ") and _is_trace_capability_error(
+                    resp
+                ):
+                    self._peer_traced = False
+                    return await self._request(line)
+                self._peer_traced = True
+                return resp
             self._peer_traced = False
             return await self._request(line)
-        self._peer_traced = True
+        if vtok is not None:
+            self._peer_stamped = True
+        if ttok is not None:
+            self._peer_traced = True
         return resp
 
     async def get(self, key: str) -> Optional[str]:
@@ -831,12 +1015,39 @@ class AsyncMerkleKVClient:
             raise ProtocolError(f"unexpected response: {resp}")
         return [await self._read_line() for _ in range(int(resp[5:]))]
 
-    async def hash(self, pattern: Optional[str] = None) -> str:
-        cmd = "HASH" if pattern is None else f"HASH {pattern}"
-        resp = _parse_simple(await self._request(cmd))
-        if not resp.startswith("HASH "):
+    async def hash(
+        self, pattern: Optional[str] = None, force: bool = False
+    ) -> str:
+        """Async HASH — same stamped-answer semantics as the sync client's
+        ``hash`` (version stamp in ``last_stamp``, ``force`` refreshes)."""
+        if pattern is not None:
+            resp = _parse_simple(await self._request(f"HASH {pattern}"))
+            if not resp.startswith("HASH "):
+                raise ProtocolError(f"unexpected response: {resp}")
+            return resp.rsplit(" ", 1)[-1]
+        resp = _parse_simple(
+            await self._traced_request(
+                "HASH", require_settled=True, stamp=True, force=force,
+                trace=False,
+            )
+        )
+        fields = resp.split(" ")
+        if len(fields) == 3 and fields[1].startswith("vs="):
+            # Old server echoed the token as a pattern: capability miss —
+            # settle and retry plain (its live answer is exact anyway).
+            self._peer_stamped = False
+            resp = _parse_simple(await self._request("HASH"))
+            fields = resp.split(" ")
+        if fields[0] != "HASH" or len(fields) not in (2, 4):
             raise ProtocolError(f"unexpected response: {resp}")
-        return resp.rsplit(" ", 1)[-1]
+        if len(fields) == 4:
+            try:
+                self.last_stamp = (int(fields[2]), int(fields[3]))
+            except ValueError as e:
+                raise ProtocolError(
+                    f"malformed HASH stamp: {resp!r}"
+                ) from e
+        return fields[1]
 
     async def leaf_hashes_page(
         self, count: int, after: str = "", upto: Optional[str] = None
@@ -853,9 +1064,11 @@ class AsyncMerkleKVClient:
             cmd = f"HASHPAGE {count} {after}"
         else:
             cmd = f"HASHPAGE {count}"
-        n = _count_after(
-            await self._traced_request(cmd, require_settled=True), "HASHES "
+        n, stamp = _parse_hashes_header(
+            await self._traced_request(cmd, require_settled=True, stamp=True)
         )
+        if stamp is not None:
+            self.last_stamp = (stamp, 0)
         rows: list[tuple[str, Optional[str], int]] = []
         for _ in range(n):
             parts = (await self._read_line()).split(" ")
@@ -876,18 +1089,26 @@ class AsyncMerkleKVClient:
         return rows, n < count
 
     async def tree_level(
-        self, level: int, lo: int, hi: int
+        self, level: int, lo: int, hi: int, force: bool = False
     ) -> tuple[list[tuple[int, str]], int]:
         """Async TREELEVEL — same semantics as the sync client's
-        ``tree_level``."""
+        ``tree_level`` (stamp in ``last_stamp``, ``force`` refreshes)."""
         resp = _parse_simple(
-            await self._traced_request(f"TREELEVEL {level} {lo} {hi}")
+            await self._traced_request(
+                f"TREELEVEL {level} {lo} {hi}", stamp=True, force=force
+            )
         )
         if not resp.startswith("NODES "):
             raise ProtocolError(f"unexpected response: {resp}")
+        fields = resp[6:].split(" ")
         try:
-            count_s, n_s = resp[6:].split(" ")
-            count, n = int(count_s), int(n_s)
+            if len(fields) == 2:
+                count, n = int(fields[0]), int(fields[1])
+            elif len(fields) == 4:
+                count, n = int(fields[0]), int(fields[1])
+                self.last_stamp = (int(fields[2]), int(fields[3]))
+            else:
+                raise ValueError("NODES header must carry 2 or 4 fields")
         except ValueError as e:
             raise ProtocolError(f"unexpected response: {resp}") from e
         rows: list[tuple[int, str]] = []

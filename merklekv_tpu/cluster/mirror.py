@@ -8,14 +8,47 @@ batches to a ``DeviceMerkleState`` — value updates are O(k log C) scatters on
 device, so a warm HASH answer costs one promotion-chain walk instead of an
 O(n) rehash.
 
-Consistency model: the mirror trails the engine by at most one drain
-interval; ``ClusterNode.device_root_hex`` flushes the replicator first, so a
-client that observed its write's response sees a root that includes it.
+Freshness contract (the async-Merkle design, PAPERS.md arxiv 2311.17441):
+writes never wait on the device plane. Staging an event batch is one lock +
+one host-dict update; the **device-update pump** — a background thread owned
+by this mirror — drains staged changes into incremental scatter dispatches
+on its own cadence and PUBLISHES the result as the served snapshot
+(version + generation + lazily cached root). Root-serving queries read the
+last-published snapshot and therefore trail the live engine by a BOUNDED
+window, governed by ``[device] max_staleness_ms`` / ``max_staleness_versions``:
+
+  - idle -> the first staged batch wakes the pump and publishes immediately;
+  - sustained load -> publishes are rate-limited to a small coalesce
+    interval (a fraction of the window), so backlog accumulates into larger
+    scatter dispatches instead of one device program per event batch — the
+    adaptive sizing is emergent: arrival rate x publish latency = batch size;
+  - the window is a hard serving bound: a breach (or a wedged pump) raises
+    a ``tree_staleness`` flight event, and the staleness gauge reads the
+    exact version lag.
+
+Exactness escape hatch: ``publish_now()`` drains synchronously — the
+``force=true`` query path (snapshot stamping, tests) and the wire-level
+forced refresh use it. Every published answer can be stamped with
+``published_version()`` so readers (anti-entropy) know which engine version
+the tree reflects.
+
+Watermark semantics (what makes ``staleness()`` exact): every staging call
+carries the engine mutation version its events are covered through — the
+replicator reads ``engine.version()`` BEFORE draining the native queue, so
+the watermark can only UNDERSTATE coverage (a racing write either made the
+drain or stages its own later event with a higher watermark). The pump's
+published version is the watermark of the last drained staging, hence
+``engine.version() - published_version`` never under-reports how far the
+served tree trails. Remote-apply staging reads the version after its own
+engine apply; a concurrent local write inside that instant can be counted
+one drain cycle early — transient, corrected by the next local drain's
+conservative watermark.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind
@@ -23,9 +56,19 @@ from merklekv_tpu.native_bindings import NativeEngine
 
 __all__ = ["DeviceTreeMirror"]
 
+# One tree_staleness flight flag per this many seconds (same one-flag-per-
+# window discipline as the blackbox slow-command bursts).
+_STALENESS_FLAG_WINDOW_S = 10.0
+
 
 class DeviceTreeMirror:
-    def __init__(self, engine: NativeEngine, sharded: bool = False) -> None:
+    def __init__(
+        self,
+        engine: NativeEngine,
+        sharded: bool = False,
+        max_staleness_ms: float = 200.0,
+        max_staleness_versions: int = 0,
+    ) -> None:
         self._engine = engine
         # Shard the device tree's leaf level over ALL local JAX devices
         # (GSPMD over a "key" mesh) instead of living on one chip — the
@@ -41,11 +84,31 @@ class DeviceTreeMirror:
         # current values when the built state is swapped in.
         self._pending: Optional[set] = None
         self._pending_truncate = False
-        # Engine mutation version observed at the last applied batch — the
-        # staleness gauge's anchor ("versions behind live"). Approximate by
-        # design: a write racing the post-apply read is counted as synced
-        # one batch early, never unboundedly.
-        self._synced_version = 0
+        # Freshness contract ([device]): the serving window the pump keeps
+        # the published tree inside. ms is the wall bound; versions (0=off)
+        # additionally forces an immediate publish once the backlog deepens
+        # past it (skipping the coalesce delay).
+        self._window_s = max(0.001, float(max_staleness_ms) / 1000.0)
+        self._max_lag_versions = max(0, int(max_staleness_versions))
+        # Publish rate limit under sustained load — the emergent-batching
+        # knob. A fraction of the window so several pump cycles always fit
+        # inside the contract.
+        self._coalesce_s = min(0.005, self._window_s / 8.0)
+        # Engine-version watermark the staging covers (see module
+        # docstring) and the watermark of the last PUBLISHED snapshot.
+        self._staged_version = 0
+        self._published_version = 0
+        self._published_gen = 0  # bumps on every publish; keys the root cache
+        self._published_root: Optional[str] = None  # lazy per generation
+        self._staged_since_m: Optional[float] = None  # oldest unpublished stage
+        self._last_publish_m = 0.0
+        self._staleness_flagged_m = -1e18
+        # The device-update pump.
+        self._pump_wake = threading.Event()
+        self._pump_thread: Optional[threading.Thread] = None
+        # Test hook: callable raised/invoked inside the pump's drain (chaos
+        # tests kill the pump mid-drain through it). None in production.
+        self._pump_inject = None
 
     # -- warm-up -------------------------------------------------------------
     def ready(self) -> bool:
@@ -57,13 +120,20 @@ class DeviceTreeMirror:
         with self._mu:
             self._state = None
             self._pending = None
+            self._published_root = None
+            self._staged_since_m = None
         self._warming.clear()
 
     def close(self) -> None:
         """Stop using the engine. MUST be called before the native engine is
-        destroyed — the warm thread snapshots through its raw pointer."""
+        destroyed — the warm thread and the pump read through its raw
+        pointer."""
         with self._mu:
             self._closed = True
+        self._pump_wake.set()
+        p = self._pump_thread
+        if p is not None and p.is_alive():
+            p.join(timeout=30)
         t = self._warm_thread
         if t is not None and t.is_alive():
             t.join(timeout=30)
@@ -77,8 +147,8 @@ class DeviceTreeMirror:
         mirror lock — holding it would stall the replicator drain loop and
         inbound LWW applies for the whole compile. Writes landing during
         the build are recorded (keys only) and replayed from the engine's
-        current values at swap-in; a truncate mid-build restarts it.
-        """
+        current values at swap-in; a truncate mid-build restarts it."""
+        self._ensure_pump()
         if self._warming.is_set():
             return
         self._warming.set()
@@ -91,6 +161,11 @@ class DeviceTreeMirror:
                             return
                         self._pending = set()
                         self._pending_truncate = False
+                        # Watermark BEFORE the snapshot: every mutation at
+                        # or below it is in the snapshot by construction;
+                        # later ones either land in _pending or stage their
+                        # own event with a higher watermark.
+                        v0 = self._engine.version()
                         items = self._engine.snapshot()
                     cls = self._device_state_cls()
                     st = cls.from_items(items, sharding=self._make_sharding())
@@ -108,8 +183,12 @@ class DeviceTreeMirror:
                             st.apply(
                                 [(k, self._engine.get(k)) for k in pend]
                             )
+                            st.flush_pending()
                         self._state = st
-                        self._synced_version = self._engine.version()
+                        self._staged_version = max(
+                            self._staged_version, v0
+                        )
+                        self._publish_locked()
                         return
             except Exception:
                 pass
@@ -120,8 +199,10 @@ class DeviceTreeMirror:
         )
         self._warm_thread.start()
 
-    # -- event feeds ---------------------------------------------------------
-    def on_events(self, events: list[ChangeEvent]) -> None:
+    # -- event feeds (staging: never device work beyond PENDING_LIMIT) -------
+    def on_events(
+        self, events: list[ChangeEvent], watermark: Optional[int] = None
+    ) -> None:
         """Local writes, drained from the native event queue in batches.
 
         The event's payload value is deliberately ignored: local events
@@ -130,7 +211,10 @@ class DeviceTreeMirror:
         older value than the engine. Re-reading the engine's CURRENT value
         for each touched key makes every batch a convergence step — any
         write racing the read stages its own later event.
-        """
+
+        ``watermark`` is the engine version read BEFORE the queue drain
+        (conservative coverage — see the module docstring); None falls back
+        to a read at staging time."""
         with self._mu:
             if self._closed:
                 return
@@ -142,18 +226,29 @@ class DeviceTreeMirror:
                 )
                 return
             touched: dict[bytes, None] = {}
+            truncated = False
             for ev in events:
                 if ev.op is OpKind.TRUNCATE:
                     # Everything before the truncate is dead.
                     touched.clear()
                     self._state = self._empty_state()
+                    truncated = True
                     continue
                 touched[ev.key.encode("utf-8", "surrogateescape")] = None
             if touched:
                 self._state.apply(
                     [(k, self._engine.get(k)) for k in touched]
                 )
-            self._synced_version = self._engine.version()
+            self._note_staged(watermark)
+            if truncated:
+                # The served tree content changed in place (reset): flush
+                # whatever was staged after the truncate and publish, so the
+                # generation moves with the content and stamps stay
+                # truthful.
+                self._state.flush_pending()
+                self._publish_locked()
+        self._ensure_pump()  # a dead pump is respawned by fresh staging
+        self._pump_wake.set()
 
     def apply_one(self, key: bytes, value: Optional[bytes]) -> None:
         """One remote write (anti-entropy repair hook)."""
@@ -173,7 +268,23 @@ class DeviceTreeMirror:
                 self._note_pending(k for k, _ in pairs)
                 return
             self._state.apply(pairs)
-            self._synced_version = self._engine.version()
+            self._note_staged(None)
+        self._ensure_pump()  # a dead pump is respawned by fresh staging
+        self._pump_wake.set()
+
+    def _note_staged(self, watermark: Optional[int]) -> None:
+        """Bookkeeping after a staging call (lock held): advance the staged
+        watermark, start the lag clock, and — when the state auto-flushed at
+        PENDING_LIMIT — publish inline so the served tree content can never
+        move without a generation/version bump."""
+        wm = watermark if watermark is not None else self._engine.version()
+        self._staged_version = max(self._staged_version, wm)
+        if self._staged_since_m is None:
+            self._staged_since_m = time.monotonic()
+        if self._state is not None and self._state.pending_count() == 0:
+            # DeviceMerkleState.apply flushed at its PENDING_LIMIT ceiling:
+            # the built tree just advanced past the published generation.
+            self._publish_locked()
 
     def _note_pending(self, keys) -> None:
         """Record writes landing during a warm build (lock held by caller).
@@ -187,23 +298,206 @@ class DeviceTreeMirror:
             else:
                 self._pending.add(k)
 
-    # -- queries -------------------------------------------------------------
+    # -- the device-update pump ----------------------------------------------
+    def _ensure_pump(self) -> None:
+        """Start (or restart after a death) the pump thread. Cheap when the
+        thread is alive; a pump killed by device trouble mid-drain is
+        respawned by the next warm-up, so one wedged drain never leaves the
+        mirror permanently unpumped."""
+        with self._mu:
+            if self._closed:
+                return
+            p = self._pump_thread
+            if p is not None and p.is_alive():
+                return
+            self._pump_thread = threading.Thread(
+                target=self._pump_loop, daemon=True, name="mkv-mirror-pump"
+            )
+            self._pump_thread.start()
+
+    def _pump_loop(self) -> None:
+        from merklekv_tpu.utils.tracing import get_metrics
+
+        while True:
+            self._pump_wake.wait(timeout=self._window_s)
+            self._pump_wake.clear()
+            with self._mu:
+                if self._closed:
+                    return
+                st = self._state
+                ver_lag = self._staged_version - self._published_version
+                behind = (
+                    st is not None
+                    and (st.pending_count() > 0 or ver_lag > 0)
+                )
+            if behind:
+                # Coalesce under sustained load: a publish that would land
+                # hot on the heels of the previous one waits a beat so the
+                # backlog accumulates into one larger scatter dispatch.
+                # Idle arrivals (last publish long ago) and deep backlogs
+                # (past the versions knob, measured in ENGINE MUTATIONS
+                # like the config documents — a hot single key rewritten N
+                # times is N versions behind, not 1 staged key) drain
+                # immediately.
+                since = time.monotonic() - self._last_publish_m
+                wait = self._coalesce_s - since
+                deep = (
+                    self._max_lag_versions
+                    and ver_lag >= self._max_lag_versions
+                )
+                if wait > 0 and not deep:
+                    time.sleep(min(wait, self._window_s / 2))
+                try:
+                    self.publish_now()
+                    get_metrics().inc("device.pump_batches")
+                except Exception:
+                    # A wedged device drain must not serve a divergent tree
+                    # forever: flag the timeline, then throw the state away
+                    # (queries fall back to the native path and trigger a
+                    # re-warm, which also respawns this pump if the failure
+                    # killed it). The flag rides the tree_staleness event —
+                    # after invalidate() the breach check goes silent
+                    # (state None), so this is the one chance to record
+                    # the drain death.
+                    get_metrics().inc("device.pump_errors")
+                    try:
+                        since = self._staged_since_m
+                        lag_ms = (
+                            0.0 if since is None
+                            else (time.monotonic() - since) * 1000.0
+                        )
+                        # Quiet the generic breach flag for a window: this
+                        # explicit event IS the flag for this failure.
+                        self._staleness_flagged_m = time.monotonic()
+                        from merklekv_tpu.obs.flightrec import record
+
+                        record(
+                            "tree_staleness",
+                            lag_ms=int(lag_ms),
+                            lag_versions=int(max(0, ver_lag)),
+                            window_ms=int(self._window_s * 1000),
+                            drain_failed=1,
+                        )
+                    except Exception:
+                        pass
+                    self.invalidate()
+            self._check_staleness_breach()
+
+    def publish_now(self) -> None:
+        """Synchronous drain + publish — the ``force=true`` escape hatch
+        (snapshot stamping, wire-level forced refresh) and the pump's own
+        drain step. Dispatches every staged change to the device and stamps
+        the published snapshot with the staged watermark."""
+        with self._mu:
+            if self._closed or self._state is None:
+                return
+            if self._pump_inject is not None:
+                self._pump_inject()  # chaos hook: die mid-drain
+            had_work = (
+                self._state.pending_count() > 0
+                or self._staged_version > self._published_version
+            )
+            self._state.flush_pending()
+            if had_work or self._published_gen == 0:
+                self._publish_locked()
+
+    def _publish_locked(self) -> None:
+        """Stamp the built tree as the served snapshot (lock held; the
+        state's pending set MUST be empty — flush before publishing, or the
+        stamp would claim coverage of undispatched changes)."""
+        self._published_version = max(
+            self._published_version, self._staged_version
+        )
+        self._published_gen += 1
+        self._published_root = None  # recomputed lazily, cached per gen
+        self._staged_since_m = None
+        self._last_publish_m = time.monotonic()
+
+    def _check_staleness_breach(self) -> None:
+        """Flight-recorder hook: one ``tree_staleness`` event per flag
+        window when the published tree trails past the contract (deep
+        version lag or a stale wall clock) — a wedged device queue then
+        shows up on the blackbox timeline instead of only as a gauge.
+
+        Deliberately LOCK-FREE: the exact failure this event exists for is
+        a pump wedged inside a device dispatch while HOLDING ``_mu`` — a
+        lock-taking check could never run then. It reads plain attributes
+        (atomic in CPython; a torn read costs at most one spurious or
+        missed flag, never a wrong serve), and it is invoked both by the
+        pump loop and by the monitoring reads (``pump_lag_ms`` — polled
+        every second by the flight sampler via the gauge), so a dead or
+        stuck pump is still flagged."""
+        if self._closed or self._state is None:
+            return
+        since = self._staged_since_m
+        lag_ms = (
+            0.0 if since is None
+            else max(0.0, (time.monotonic() - since) * 1000.0)
+        )
+        try:
+            lag_v = max(0, self._engine.version() - self._published_version)
+        except Exception:
+            return
+        breached = lag_ms > self._window_s * 1000.0 or (
+            self._max_lag_versions
+            and lag_v > self._max_lag_versions
+            and since is not None
+        )
+        now = time.monotonic()
+        if (
+            not breached
+            or now - self._staleness_flagged_m < _STALENESS_FLAG_WINDOW_S
+        ):
+            return
+        self._staleness_flagged_m = now
+        from merklekv_tpu.obs.flightrec import record
+
+        record(
+            "tree_staleness",
+            lag_ms=int(lag_ms),
+            lag_versions=int(lag_v),
+            window_ms=int(self._window_s * 1000),
+        )
+
+    # -- queries (published-snapshot serving) ---------------------------------
     def root_hex(self) -> str:
+        """EXACT root: drains staged changes first (one publish), then
+        serves. Direct-API callers (tests, snapshot verification) get
+        read-your-writes; the wire query path uses ``published_root_hex``
+        so it never waits on the device plane."""
         with self._mu:
             if self._closed:
                 raise RuntimeError("mirror closed")
             if self._state is None:
                 self._state = self._load_state()
-            return self._state.root_hex()
+                self._staged_version = max(
+                    self._staged_version, self._engine.version()
+                )
+            self.publish_now()
+            return self.published_root_hex()
 
-    def level_nodes(self, level: int, lo: int, hi: int):
-        """TREELEVEL slice from the device-resident tree: reference-level
-        ``(idx, digest)`` rows plus the leaf count, or None while the state
-        is not built (the native host fallback answers instead)."""
+    def published_root_hex(self) -> Optional[str]:
+        """Root of the last-published snapshot (None while warming): the
+        bounded-staleness serving path. Cached per publish generation, so
+        a HASH storm costs one device root walk per pump cycle, not per
+        query."""
         with self._mu:
             if self._closed or self._state is None:
                 return None
-            return self._state.level_nodes(level, lo, hi)
+            if self._published_root is None:
+                self._published_root = self._state.root_hex(flush=False)
+            return self._published_root
+
+    def level_nodes(self, level: int, lo: int, hi: int):
+        """TREELEVEL slice from the last-published device tree: reference-
+        level ``(idx, digest)`` rows plus the leaf count, or None while the
+        state is not built (the native host fallback answers instead).
+        Serves the tree AS PUBLISHED — staged changes stay staged, so a
+        walker's fetches within one generation are mutually consistent."""
+        with self._mu:
+            if self._closed or self._state is None:
+                return None
+            return self._state.level_nodes(level, lo, hi, flush=False)
 
     def leaf_count(self) -> int:
         """Leaf count of the built device tree, or -1 while warming. Reads
@@ -214,14 +508,56 @@ class DeviceTreeMirror:
                 return -1
             return self._state.leaf_count()
 
+    def published_version(self) -> int:
+        """Engine mutation version the served tree reflects (the version
+        stamp on TREELEVEL/HASH answers). 0 while warming."""
+        with self._mu:
+            return self._published_version if self._state is not None else 0
+
+    def published_root_stamped(self) -> Optional[tuple[str, int]]:
+        """(root_hex, published_version) read under ONE lock hold, so the
+        stamp can never claim a different generation than the root it rides
+        with. None while warming."""
+        with self._mu:
+            root = self.published_root_hex()
+            if root is None:
+                return None
+            return root, self._published_version
+
+    def level_nodes_stamped(self, level: int, lo: int, hi: int):
+        """``level_nodes`` plus the published version, atomically (one lock
+        hold) — the stamped TREELEVEL serve. None while warming."""
+        with self._mu:
+            out = self.level_nodes(level, lo, hi)
+            if out is None:
+                return None
+            rows, n = out
+            return rows, n, self._published_version
+
     def staleness(self) -> int:
-        """Engine mutation versions the mirror trails the live keyspace by
-        (0 = fully caught up; -1 while warming). Only meaningful on
-        version-tracking engines (the sharded/log natives)."""
+        """Engine mutation versions the PUBLISHED tree trails the live
+        keyspace by (0 = fully caught up; -1 while warming). Exact against
+        ``mkv_engine_version`` up to the conservative-watermark semantics
+        in the module docstring. Only meaningful on version-tracking
+        engines (the sharded/log natives)."""
         with self._mu:
             if self._closed or self._state is None:
                 return -1  # also guards the engine FFI after close()
-            return max(0, self._engine.version() - self._synced_version)
+            return max(0, self._engine.version() - self._published_version)
+
+    def pump_lag_ms(self) -> float:
+        """Milliseconds the oldest staged-but-unpublished change has waited
+        (0.0 when the pump is caught up) — the wall half of the staleness
+        contract, and the ``device.pump_lag_ms`` gauge. Lock-free (plain
+        attribute reads) so a pump wedged under ``_mu`` cannot block the
+        monitoring plane; each read also runs the breach check, which is
+        how a wedged/dead pump still lands a ``tree_staleness`` event via
+        the flight sampler's 1 s gauge poll."""
+        since = self._staged_since_m
+        self._check_staleness_breach()
+        if since is None or self._state is None:
+            return 0.0
+        return max(0.0, (time.monotonic() - since) * 1000.0)
 
     @property
     def state(self):

@@ -85,6 +85,7 @@ class ClusterNode:
             bisect_threshold=cfg.anti_entropy.bisect_threshold,
             on_cycle_converged=self.lag_tracker.on_converged,
             max_skew_ms=cfg.replication.max_skew_ms,
+            tree_lag_limit=cfg.device.max_staleness_versions,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -337,6 +338,10 @@ class ClusterNode:
                 self._mirror = DeviceTreeMirror(
                     self._engine,
                     sharded=self._cfg.device.sharded_mirror,
+                    max_staleness_ms=self._cfg.device.max_staleness_ms,
+                    max_staleness_versions=(
+                        self._cfg.device.max_staleness_versions
+                    ),
                 )
             storage = self._storage
             if storage is not None:
@@ -629,13 +634,21 @@ class ClusterNode:
             else:
                 storage.record_set(key, value, ts)
 
-    def _query_ready_mirror(self, fn):
+    def _query_ready_mirror(self, fn, force: bool = False):
         """Shared gate for device-tree reads (HASH root, TREELEVEL slices):
-        returns ``fn(mirror)`` after flushing staged events through the
-        replicator (read-your-writes), or None whenever the device path
-        can't answer — replication off, device disabled, mirror still
-        warming (a warm-up is kicked off), or any device failure — so the
-        native fallback serves instead and nothing stalls on the device."""
+        returns ``fn(mirror)``, or None whenever the device path can't
+        answer — replication off, device disabled, mirror still warming (a
+        warm-up is kicked off), or any device failure — so the native
+        fallback serves instead and nothing stalls on the device.
+
+        The freshness contract: the DEFAULT path serves the pump's
+        last-published snapshot and performs NO synchronous replicator
+        flush — a root-serving query never serializes behind the write
+        stream; the tree trails live by at most the [device] max_staleness
+        window. ``force=True`` is the explicit exactness escape hatch
+        (snapshot stamping, the wire's vs=03 forced refresh): drain staged
+        events through the replicator, pump them to the device, THEN
+        serve."""
         with self._rep_mu:
             rep, mirror = self._replicator, self._mirror
         if rep is None or mirror is None:
@@ -644,25 +657,35 @@ class ClusterNode:
             mirror.start_warming()  # no-op if already in flight
             return None
         try:
-            rep.flush()  # serve root-consistent state: drain staged events
+            if force:
+                rep.flush()  # native queue -> mirror staging
+                mirror.publish_now()  # staging -> served snapshot
             return fn(mirror)
         except Exception:
             return None  # native fallback answers instead
 
-    def device_tree_level(self, level: int, lo: int, hi: int):
-        """TREELEVEL answer from the live device tree: ``(rows, n)`` with
-        reference-level ``(idx, digest)`` rows, or None when the mirror
-        isn't ready (the native server's host-side cached tree answers
-        meanwhile, so peers' walks never stall on a warming mirror)."""
+    def device_tree_level(
+        self, level: int, lo: int, hi: int, force: bool = False
+    ):
+        """TREELEVEL answer from the last-published device tree:
+        ``(rows, n)`` with reference-level ``(idx, digest)`` rows, or None
+        when the mirror isn't ready (the native server's host-side cached
+        tree answers meanwhile, so peers' walks never stall on a warming
+        mirror)."""
         return self._query_ready_mirror(
-            lambda m: m.level_nodes(level, lo, hi)
+            lambda m: m.level_nodes(level, lo, hi), force=force
         )
 
-    def device_root_hex(self) -> Optional[str]:
-        """Whole-keyspace Merkle root from the live device tree, or None
-        when the mirror isn't ready (replication off / device disabled /
-        still warming — the native path answers meanwhile)."""
-        return self._query_ready_mirror(lambda m: m.root_hex())
+    def device_root_hex(self, force: bool = False) -> Optional[str]:
+        """Whole-keyspace Merkle root from the last-published device tree,
+        or None when the mirror isn't ready (replication off / device
+        disabled / still warming — the native path answers meanwhile).
+        ``force=True`` drains the write stream to the device first and
+        serves an exact root (read-your-writes for snapshot verification
+        and tests; the default bounded-staleness path never waits)."""
+        return self._query_ready_mirror(
+            lambda m: m.published_root_hex(), force=force
+        )
 
     @property
     def health(self):
@@ -727,6 +750,13 @@ class ClusterNode:
                 mirror = self._mirror
             return mirror.staleness() if mirror is not None else -1
 
+        def pump_lag_ms() -> int:
+            with self._rep_mu:
+                mirror = self._mirror
+            return (
+                int(round(mirror.pump_lag_ms())) if mirror is not None else -1
+            )
+
         def outbox_depth() -> int:
             t = self._transport
             return getattr(t, "outbox_depth", 0) if t is not None else 0
@@ -755,8 +785,17 @@ class ClusterNode:
              "Leaf count of the device-resident Merkle tree "
              "(-1: no mirror).", ""),
             ("device.mirror_staleness", mirror_staleness,
-             "Engine mutation versions the device mirror trails the live "
-             "keyspace by (-1: no mirror).", ""),
+             "Engine mutation versions the PUBLISHED device tree trails "
+             "the live keyspace by — exact against mkv_engine_version via "
+             "the pump's applied-version watermark (-1: no mirror).", ""),
+            ("device.pump_lag_versions", mirror_staleness,
+             "Pump-plane alias of device.mirror_staleness: versions the "
+             "device-update pump has staged but not yet published (-1: no "
+             "mirror).", ""),
+            ("device.pump_lag_ms", pump_lag_ms,
+             "Milliseconds the oldest staged-but-unpublished device-tree "
+             "change has waited on the pump (0: caught up; -1: no "
+             "mirror).", ""),
             ("replication.outbox_depth", outbox_depth,
              "Events queued in the transport outbox awaiting a broker "
              "heal.", ""),
@@ -845,6 +884,33 @@ class ClusterNode:
         for src, v in sorted(self.lag_tracker.lag_ms().items()):
             lines.append(f"replication.lag_ms.{src}:{int(round(v))}")
         lines.append(f"readiness_code:{self.lag_tracker.readiness_code()}")
+        # Device freshness plane: pump lag (versions + ms) and the
+        # engine-vs-served tree versions, so wire-only consumers (top's
+        # STALE and VER columns) see the staleness contract without
+        # scraping /metrics. Integer-text contract like every METRICS line.
+        with self._rep_mu:
+            mirror = self._mirror
+        if mirror is not None and mirror.ready():
+            # Gated on ready(): a warming mirror has no published tree, and
+            # tree_version 0 would read as "202 versions stale" in top's
+            # VER column instead of "no device serving yet" ("-").
+            try:
+                lines.append(
+                    f"device.pump_lag_versions:{mirror.staleness()}"
+                )
+                lines.append(
+                    "device.pump_lag_ms:"
+                    f"{int(round(mirror.pump_lag_ms()))}"
+                )
+                lines.append(
+                    f"device.tree_version:{mirror.published_version()}"
+                )
+                if self._engine._h:
+                    lines.append(
+                        f"node.engine_version:{self._engine.version()}"
+                    )
+            except Exception:
+                pass  # a dying mirror drops its lines, not METRICS
         # Overload plane: the ladder rung plus the native shed counters
         # (one stats_text read), so wire-only consumers (top's STATE and
         # SHED/s columns) see overload state without scraping /metrics.
@@ -871,6 +937,30 @@ class ClusterNode:
             pass  # a dead server handle drops the shed lines, not METRICS
         body = "".join(f"{ln}\r\n" for ln in lines)
         return f"METRICS\r\n{body}END\r\n"
+
+    @staticmethod
+    def _take_version_flags(args: list[str]) -> tuple[bool, bool]:
+        """(want_version, force_refresh) from a trailing vs=XX token the
+        native parser relayed on HASH/TREELEVEL callback lines."""
+        for p in args:
+            if len(p) == 5 and p.startswith("vs="):
+                try:
+                    flags = int(p[3:], 16)
+                except ValueError:
+                    continue
+                return bool(flags & 1), bool(flags & 2)
+        return False, False
+
+    def _version_lag(self, served_version: int) -> int:
+        """Mutations the live engine has moved past the served tree — the
+        lag half of a stamped answer. A dead engine handle reads as 0
+        rather than driving the FFI through a closed pointer."""
+        try:
+            if not self._engine._h:
+                return 0
+            return max(0, self._engine.version() - served_version)
+        except Exception:
+            return 0
 
     # -- cluster command callback ---------------------------------------------
     def _on_cluster_command(self, line: str) -> Optional[str]:
@@ -932,22 +1022,51 @@ class ClusterNode:
         if parts[0] == "PROFILE":
             return self._profile_wire(int(parts[1]))
         if parts[0] == "HASH":
-            # Whole-keyspace root served from the device-resident
-            # incremental tree; empty answer falls back to the native path.
-            root = self.device_root_hex()
-            return f"HASH {root}\r\n" if root is not None else None
-        if parts[0] == "TREELEVEL":
-            # Bisection-walk node fetch served from the device-resident
-            # tree (one batched device gather per request); empty answer
-            # falls back to the native server's cached host tree.
-            out = self.device_tree_level(
-                int(parts[1]), int(parts[2]), int(parts[3])
+            # Whole-keyspace root served from the device pump's
+            # last-published snapshot; empty answer falls back to the
+            # native path. A trailing vs= token (relayed verbatim by the
+            # native parser) asks for the version stamp / forced refresh.
+            want, force = self._take_version_flags(parts[1:])
+            if not want:
+                root = self.device_root_hex(force=force)
+                return f"HASH {root}\r\n" if root is not None else None
+            out = self._query_ready_mirror(
+                lambda m: m.published_root_stamped(), force=force
             )
             if out is None:
                 return None
-            rows, n = out
+            root, ver = out
+            return f"HASH {root} {ver} {self._version_lag(ver)}\r\n"
+        if parts[0] == "TREELEVEL":
+            # Bisection-walk node fetch served from the pump's
+            # last-published tree (one batched device gather per request);
+            # empty answer falls back to the native server's cached host
+            # tree. Stamped when the request carried a vs= token.
+            args = [p for p in parts[1:] if not p.startswith("vs=")]
+            want, force = self._take_version_flags(parts[1:])
+            if not want:
+                out = self.device_tree_level(
+                    int(args[0]), int(args[1]), int(args[2]), force=force
+                )
+                if out is None:
+                    return None
+                rows, n = out
+                body = "".join(f"{i} {d.hex()}\r\n" for i, d in rows)
+                return f"NODES {len(rows)} {n}\r\n{body}"
+            out = self._query_ready_mirror(
+                lambda m: m.level_nodes_stamped(
+                    int(args[0]), int(args[1]), int(args[2])
+                ),
+                force=force,
+            )
+            if out is None:
+                return None
+            rows, n, ver = out
             body = "".join(f"{i} {d.hex()}\r\n" for i, d in rows)
-            return f"NODES {len(rows)} {n}\r\n{body}"
+            return (
+                f"NODES {len(rows)} {n} {ver} {self._version_lag(ver)}\r\n"
+                f"{body}"
+            )
         if parts[0] == "SNAPMETA":
             return self._snap_meta_wire()
         if parts[0] == "SNAPCHUNK":

@@ -145,9 +145,11 @@ class Replicator:
             apply_batch_fn=engine.apply_batch,
         )
         self._applier_mu = threading.Lock()
-        # Spans drain..mirror-apply: a flush() must not return while another
-        # thread holds drained-but-unapplied events, or device_root_hex's
-        # read-your-writes guarantee breaks.
+        # Spans drain..mirror-staging: a flush() must not return while
+        # another thread holds drained-but-unstaged events — once flush()
+        # returns, every event acked before it is at least STAGED in the
+        # mirror (the pump's publish_now() then makes it served, which is
+        # what the force=true query path composes).
         self._flush_mu = threading.Lock()
         self._stop = threading.Event()
         self._drain_thread: Optional[threading.Thread] = None
@@ -214,16 +216,24 @@ class Replicator:
     def flush(self) -> int:
         """Drain and publish pending native write events once."""
         with self._flush_mu:
+            # Watermark BEFORE the drain: every engine mutation at or below
+            # it either staged an event this drain collects, or will stage
+            # one later with a higher watermark — so the mirror's staleness
+            # accounting can only err conservative (see mirror.py).
+            watermark = self._engine.version()
             raws = self._server.drain_events()
             if not raws:
                 return 0
             events = [self._to_event(r) for r in raws]
             # Mirror first: once events leave the native queue they are the
             # mirror's only chance to see these keys — a publish failure
-            # must not cost the mirror the batch.
+            # must not cost the mirror the batch. Staging is host-side and
+            # cheap; the mirror's pump owns the device dispatch, so this
+            # drain thread (and the write path behind it) never waits on
+            # the device plane.
             if self._mirror is not None:
                 try:
-                    self._mirror.on_events(events)
+                    self._mirror.on_events(events, watermark=watermark)
                 except Exception:
                     # Device trouble: a silently-dropped batch would serve a
                     # divergent root forever; invalidate so HASH falls back

@@ -96,6 +96,16 @@ class SyncReport:
     mode: str = ""
     verified: Optional[bool] = None  # post-sync root recheck (--verify)
     resumed: bool = False  # this cycle continued an interrupted session
+    # Version-stamp plane (bounded-staleness donors): the engine version
+    # the donor's served tree reflected at the last stamped fetch, how far
+    # its live engine trailed it, whether this cycle escalated a stale
+    # donor tree to a forced refresh, and whether mid-walk churn was
+    # absorbed by clipping to the verified frontier instead of abandoning
+    # the walk.
+    donor_tree_version: int = 0
+    donor_tree_lag: int = 0
+    forced_refreshes: int = 0
+    walk_clipped: bool = False
     seconds: float = 0.0
     # Wire cost of the whole cycle (client-measured request/response bytes,
     # reconnects included) — the number the bisection walk shrinks from
@@ -219,6 +229,13 @@ class SyncManager:
         # holds the raw ts in its engine, and a walk/arbitration against
         # it would install that ts here, re-fencing the key. 0 disables.
         max_skew_ms: int = 0,
+        # Bounded-trailing tolerance for stamped donors ([device]
+        # max_staleness_versions): a donor whose served tree reports a lag
+        # past this many engine mutations gets ONE forced-refresh re-probe
+        # before the walk descends (its pump is presumed wedged or swamped;
+        # walking a deeply stale tree would repair against ancient state).
+        # 0 selects the default.
+        tree_lag_limit: int = 0,
     ) -> None:
         self._engine = engine
         self._device = device
@@ -257,6 +274,10 @@ class SyncManager:
         # divergence the SLO exists to surface.
         self._on_cycle_converged = on_cycle_converged
         self._max_skew_ns = max(0, int(max_skew_ms)) * 1_000_000
+        self._tree_lag_limit = (
+            int(tree_lag_limit) if tree_lag_limit > 0
+            else self._DEFAULT_TREE_LAG_LIMIT
+        )
         self._sessions: dict[str, SyncSession] = {}
         # First-checkpoint time per peer, surviving resume/re-checkpoint
         # churn: a re-checkpoint builds a fresh SyncSession, and without
@@ -288,10 +309,13 @@ class SyncManager:
 
     @staticmethod
     def _attach_trace(client: MerkleKVClient) -> MerkleKVClient:
-        """Give the client the live token provider: every cluster verb it
-        sends carries the active trace context (capability fallback drops
-        it against pre-tracing peers)."""
+        """Give the client the live token provider — every cluster verb it
+        sends carries the active trace context — and turn on version
+        stamps, so tree fetches report the engine version the donor's
+        served tree reflects (both ride the same capability fallback
+        against old peers)."""
         client.trace_provider = tracewire.current_token
+        client.version_stamps = True
         return client
 
     @staticmethod
@@ -659,6 +683,11 @@ class SyncManager:
     # each), so descending pays until the span is a handful of keys; 16
     # keeps the last hop cheap without a round trip per single leaf.
     _WALK_LEAF_SPAN = 16
+    # Default forced-refresh threshold when [device] max_staleness_versions
+    # is unset: a donor tree trailing its engine by this many mutations is
+    # past any sane pump window — deep enough that diffing against it
+    # mostly finds already-healed divergence.
+    _DEFAULT_TREE_LAG_LIMIT = 4096
 
     def _want_walk(self, sess: Optional[SyncSession]) -> bool:
         """Transfer-strategy selection for this cycle. A mid-walk resume
@@ -759,7 +788,9 @@ class SyncManager:
 
         # Capability probe + remote leaf count: a zero-width TREELEVEL. An
         # old peer answers ERROR (degrade to paging); an empty peer is
-        # cheaper to mirror with the paged scan.
+        # cheaper to mirror with the paged scan. The probe also settles the
+        # version-stamp capability and reports how far the donor's served
+        # tree trails its live engine.
         try:
             _, remote_n = client.tree_level(0, 0, 0)
         except ProtocolError:
@@ -767,6 +798,29 @@ class SyncManager:
         except (MerkleKVError, OSError) as e:
             fail_checkpoint(start, f"tree walk probe died: {e!r}")
             raise
+        stamp = client.last_stamp
+        if stamp is not None and stamp[1] > self._tree_lag_limit:
+            # Bounded trailing exceeded: the donor's pump is wedged or
+            # swamped, and a walk against its ancient tree would mostly
+            # rediscover divergence the live engine already healed.
+            # Escalate ONCE to a forced refresh (vs=03 drains the donor's
+            # pump synchronously) and walk the fresh tree.
+            try:
+                _, remote_n = client.tree_level(0, 0, 0, force=True)
+            except ProtocolError:
+                return False, None
+            except (MerkleKVError, OSError) as e:
+                fail_checkpoint(start, f"tree walk force-probe died: {e!r}")
+                raise
+            report.forced_refreshes += 1
+            metrics.inc("sync.forced_refreshes")
+            report.details.append(
+                f"{peer}: donor tree lag {stamp[1]} > "
+                f"{self._tree_lag_limit}; forced refresh"
+            )
+            stamp = client.last_stamp
+        if stamp is not None:
+            report.donor_tree_version, report.donor_tree_lag = stamp
         if remote_n <= 0:
             return False, None
 
@@ -803,6 +857,7 @@ class SyncManager:
 
         level = height - 1
         divergent = [0]  # the root differs (HASH compare, or mid-walk resume)
+        clipped = False
         while level > stop_level and divergent:
             child_level = level - 1
             m_child = sizes[child_level]
@@ -836,17 +891,45 @@ class SyncManager:
                     fail_checkpoint(start, f"tree walk died: {e!r}")
                     raise
                 if n_now != remote_n:
-                    # Keyspace churned mid-descent: node indices no longer
-                    # line up. Degrade to the paged scan, which tolerates
-                    # churn natively (reusing this cycle's local hashes).
+                    if client.last_stamp is not None:
+                        # Bounded trailing from a stamped donor: its pump
+                        # republished mid-walk (versions moved within the
+                        # staleness window), shifting leaf positions. The
+                        # frontier verified SO FAR is still sound — CLIP:
+                        # stop descending and repair the parent-level
+                        # divergent intervals with key-bounded pages
+                        # (churn-tolerant by construction) instead of
+                        # abandoning the walk to a full paged scan.
+                        report.details.append(
+                            f"{peer}: keyspace churned mid-walk "
+                            f"({remote_n} -> {n_now}); clipping to the "
+                            f"verified frontier at level {level}"
+                        )
+                        report.walk_clipped = True
+                        metrics.inc("sync.walk_clips")
+                        clipped = True
+                        break
+                    # Unstamped (old) donor: no way to tell bounded
+                    # trailing from unbounded churn — degrade to the paged
+                    # scan, which tolerates churn natively (reusing this
+                    # cycle's local hashes).
                     report.details.append(
                         f"{peer}: keyspace churned mid-walk "
                         f"({remote_n} -> {n_now}); paging instead"
                     )
                     report.mode = ""
                     return False, precomputed
+                if client.last_stamp is not None:
+                    report.donor_tree_version, report.donor_tree_lag = (
+                        client.last_stamp
+                    )
                 for i, hx in rows:
                     remote_dig[i] = bytes.fromhex(hx)
+            if clipped:
+                # Keep `divergent`/`level` at the last FULLY-compared
+                # parent frontier (the partial child fetches are from the
+                # republished tree and must not mix in).
+                break
             report.rounds += 1
             metrics.inc("sync.rounds")
             nxt = []

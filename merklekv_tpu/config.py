@@ -340,6 +340,18 @@ class DeviceConfig:
     # (GSPMD over a "key" mesh). Single-device trees are the default; on a
     # multi-chip host this spreads HBM and the rebuild across chips.
     sharded_mirror: bool = False
+    # Freshness contract of the device-update pump (cluster/mirror.py):
+    # the served tree trails the live engine by at most this wall window.
+    # Writes never wait on the device plane; the pump drains staged events
+    # into scatter batches on its own cadence, publishing immediately when
+    # idle and coalescing into bigger dispatches under load. See
+    # docs/DEPLOYMENT.md "Tree freshness sizing".
+    max_staleness_ms: float = 200.0
+    # Optional version-count bound: a staged backlog deeper than this many
+    # engine mutations skips the pump's coalesce delay and drains at once
+    # (0 = wall-window-only). Also the lag past which anti-entropy walkers
+    # escalate a stale donor tree to a forced refresh.
+    max_staleness_versions: int = 0
 
 
 @dataclass
@@ -483,6 +495,22 @@ class Config:
         dev = raw.get("device", {})
         if "sharded_mirror" in dev:
             cfg.device.sharded_mirror = bool(dev["sharded_mirror"])
+        if "max_staleness_ms" in dev:
+            cfg.device.max_staleness_ms = float(dev["max_staleness_ms"])
+        if "max_staleness_versions" in dev:
+            cfg.device.max_staleness_versions = int(
+                dev["max_staleness_versions"]
+            )
+        if cfg.device.max_staleness_ms <= 0:
+            raise ValueError(
+                "[device] max_staleness_ms must be > 0, got "
+                f"{cfg.device.max_staleness_ms}"
+            )
+        if cfg.device.max_staleness_versions < 0:
+            raise ValueError(
+                "[device] max_staleness_versions must be >= 0 (0 = wall "
+                f"window only), got {cfg.device.max_staleness_versions}"
+            )
         obs = raw.get("observability", {})
         if "http_port" in obs:
             cfg.observability.http_port = int(obs["http_port"])

@@ -289,12 +289,22 @@ class DeviceMerkleState:
     # ------------------------------------------------------------ updates
     def apply(self, changes: Sequence[tuple[bytes, Optional[bytes]]]) -> None:
         """Stage (key, value|None-for-delete) changes; last write per key
-        wins. Device work is deferred to the next query so bursts of
-        single-key applies amortize into one batch."""
+        wins. Device work is deferred to the next flush (the mirror's pump
+        cycle, or the next exact query) so bursts of single-key applies
+        amortize into one batch."""
         for k, v in changes:
             self._pending[k] = v
         if len(self._pending) >= self.PENDING_LIMIT:
             self._flush()
+
+    def pending_count(self) -> int:
+        """Staged-but-undispatched changes (no device work to read it)."""
+        return len(self._pending)
+
+    def flush_pending(self) -> None:
+        """Dispatch every staged change to the device now — the pump's
+        drain step. Idempotent when nothing is staged."""
+        self._flush()
 
     def _flush(self) -> None:
         if not self._pending:
@@ -474,8 +484,13 @@ class DeviceMerkleState:
         m.observe("device.restructure_dispatch", _time.perf_counter() - t0)
 
     # ------------------------------------------------------------ queries
-    def root_hash(self) -> Optional[bytes]:
-        self._flush()
+    def root_hash(self, flush: bool = True) -> Optional[bytes]:
+        """Reference-tree root. ``flush=False`` serves the tree AS BUILT —
+        staged changes stay staged — so a bounded-staleness reader (the
+        mirror's published snapshot) never triggers device work beyond the
+        root walk itself."""
+        if flush:
+            self._flush()
         if not len(self._keys) or self._levels is None:
             return None
         root = _ref_root_fn(self._capacity)(
@@ -483,8 +498,8 @@ class DeviceMerkleState:
         )
         return digest_to_bytes(np.asarray(root))
 
-    def root_hex(self) -> str:
-        r = self.root_hash()
+    def root_hex(self, flush: bool = True) -> str:
+        r = self.root_hash(flush=flush)
         return r.hex() if r is not None else "0" * 64
 
     def leaf_digest(self, key: bytes) -> Optional[bytes]:
@@ -529,15 +544,19 @@ class DeviceMerkleState:
             m = (m + 1) // 2
         return last
 
-    def level_nodes(self, level: int, lo: int, hi: int) -> tuple[list[tuple[int, bytes]], int]:
+    def level_nodes(
+        self, level: int, lo: int, hi: int, flush: bool = True
+    ) -> tuple[list[tuple[int, bytes]], int]:
         """Reference-tree digests at ``level`` for indices ``[lo, hi)``
         (clamped to the level's size), plus the live leaf count — the
         device-side answer to the TREELEVEL wire verb. One batched device
         gather serves the whole slice; the only host hashing is the O(level)
         promotion-chain correction when the slice touches the level's last
         node. Digests are bit-identical to the reference tree (and hence to
-        the native server's host fallback)."""
-        self._flush()
+        the native server's host fallback). ``flush=False`` serves the tree
+        as built (the published-snapshot read path)."""
+        if flush:
+            self._flush()
         n = len(self._keys)
         if n == 0 or self._levels is None:
             return [], 0

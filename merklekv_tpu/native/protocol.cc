@@ -158,6 +158,28 @@ std::string take_trace_token(std::vector<std::string>* toks) {
   return t;
 }
 
+// If the last token is a version-stamp token ("vs=" + 2 hex flags), pop it
+// and return its flags; -1 when absent. Clients append it BEFORE the trace
+// token, so callers strip the trace token first, then this.
+int take_version_flags(std::vector<std::string>* toks) {
+  if (toks->empty() || !is_version_token(toks->back())) return -1;
+  const std::string& t = toks->back();
+  auto hexval = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    return c - 'A' + 10;
+  };
+  int flags = hexval(t[3]) * 16 + hexval(t[4]);
+  toks->pop_back();
+  return flags;
+}
+
+void apply_version_flags(Command* c, int flags) {
+  if (flags < 0) return;
+  c->want_version = (flags & 1) != 0;
+  c->force_refresh = (flags & 2) != 0;
+}
+
 }  // namespace
 
 bool is_trace_token(const std::string& tok) {
@@ -171,6 +193,12 @@ bool is_trace_token(const std::string& tok) {
   };
   return hex(3, 16) && tok[19] == '-' && hex(20, 16) && tok[36] == '-' &&
          hex(37, 2);
+}
+
+bool is_version_token(const std::string& tok) {
+  // "vs=" + exactly 2 hex flag digits.
+  return tok.size() == 5 && tok.compare(0, 3, "vs=") == 0 &&
+         std::isxdigit(uint8_t(tok[3])) && std::isxdigit(uint8_t(tok[4]));
 }
 
 ParseResult parse_command(const std::string& line) {
@@ -323,13 +351,21 @@ ParseResult parse_command(const std::string& line) {
     return ok(std::move(c));
   }
   if (u == "HASH") {
-    if (rest.find(' ') != std::string::npos) {
+    // Optional trailing version-stamp token ("HASH [pattern] [vs=XX]"):
+    // stamping is meaningful on the bare whole-keyspace form (the root
+    // anti-entropy compares); the pattern form keeps its legacy shape.
+    auto toks = split_ws(rest);
+    int vflags = take_version_flags(&toks);
+    if (toks.size() > 1) {
       return err("HASH command accepts only one argument");
     }
-    if (auto e = bad_char(rest, "key")) return err(*e);
+    if (!toks.empty()) {
+      if (auto e = bad_char(toks[0], "key")) return err(*e);
+    }
     Command c;
     c.verb = Verb::Hash;
-    c.pattern = rest;
+    c.pattern = toks.empty() ? "" : toks[0];
+    apply_version_flags(&c, vflags);
     return ok(std::move(c));
   }
   if (u == "REPLICATE") {
@@ -383,6 +419,7 @@ ParseResult parse_command(const std::string& line) {
     // span is what stitches that peer into the cycle's trace.
     auto toks = split_ws(rest);
     std::string trace = take_trace_token(&toks);
+    int vflags = take_version_flags(&toks);
     if (toks.size() > 1) {
       return err("LEAFHASHES command accepts only one argument");
     }
@@ -393,6 +430,7 @@ ParseResult parse_command(const std::string& line) {
     c.verb = Verb::LeafHashes;
     c.trace = std::move(trace);
     c.prefix = toks.empty() ? "" : toks[0];
+    apply_version_flags(&c, vflags);
     return ok(std::move(c));
   }
   if (u == "HASHPAGE") {
@@ -403,6 +441,7 @@ ParseResult parse_command(const std::string& line) {
     // first (its fixed tc= shape cannot collide with a real cursor key).
     auto toks = split_ws(rest);
     std::string trace = take_trace_token(&toks);
+    int vflags = take_version_flags(&toks);
     if (toks.empty() || toks.size() > 3) {
       return err("HASHPAGE requires arguments: <count> [<after> [<upto>]]");
     }
@@ -425,6 +464,7 @@ ParseResult parse_command(const std::string& line) {
       }
       c.upto = toks[2];
     }
+    apply_version_flags(&c, vflags);
     return ok(std::move(c));
   }
   if (u == "TREELEVEL") {
@@ -434,6 +474,7 @@ ParseResult parse_command(const std::string& line) {
     // trace-context token stitches the serve into the walker's trace.
     auto toks = split_ws(rest);
     std::string trace = take_trace_token(&toks);
+    int vflags = take_version_flags(&toks);
     if (toks.size() != 3) {
       return err("TREELEVEL requires arguments: <level> <lo> <hi>");
     }
@@ -451,6 +492,7 @@ ParseResult parse_command(const std::string& line) {
     c.level = level;
     c.lo = lo;
     c.hi = hi;
+    apply_version_flags(&c, vflags);
     return ok(std::move(c));
   }
   if (u == "SNAPMETA") {

@@ -91,6 +91,17 @@ struct Command {
   // initiator's trace; empty = untraced request. Strictly-formatted so a
   // real key can never be mistaken for it (see is_trace_token).
   std::string trace;
+  // Version-stamp request: the optional trailing "vs=<2 hex flags>" token
+  // on the tree-serving verbs (HASH/TREELEVEL/LEAFHASHES/HASHPAGE),
+  // stripped BEFORE arity checks like the trace token. Bit 0 (want_version)
+  // asks the reply header to carry the engine mutation version the served
+  // tree reflects (plus its lag for snapshot-serving verbs); bit 1
+  // (force_refresh) asks the server to refresh the tree to the live engine
+  // before answering — the anti-entropy escalation / snapshot-exactness
+  // escape hatch. Old servers reject the extra token with an arity ERROR
+  // (fail closed); clients drop it per connection and retry plain.
+  bool want_version = false;
+  bool force_refresh = false;
   std::string host;                // Sync
   uint16_t port = 0;               // Sync
   bool full = false, verify = false;  // Sync flags (parsed, ignored — parity)
@@ -112,5 +123,12 @@ ParseResult parse_command(const std::string& line);
 // The fixed shape is what lets it ride as a trailing argument on verbs
 // whose other arguments are keys without ambiguity.
 bool is_trace_token(const std::string& tok);
+
+// True iff `tok` is a well-formed version-stamp token: "vs=" + exactly 2
+// hex flag digits. Same trailing-token discipline as the trace token; the
+// fixed 5-char shape keeps collision with real keys/cursors negligible
+// (and the verbs where a collision would be silent require a settled
+// capability first — docs/PROTOCOL.md "Version-stamped tree answers").
+bool is_version_token(const std::string& tok);
 
 }  // namespace mkv

@@ -1203,22 +1203,35 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
       std::string prefix = (pat == "*") ? "" : pat;
       if (pat.empty()) {
         // Bare HASH only ("HASH *" echoes the pattern, a different wire
-        // shape): give the control plane first refusal — it serves from
-        // the device-resident incremental tree in O(1) after warm build
-        // instead of rehashing every leaf here.
+        // shape): give the control plane first refusal — it serves the
+        // device pump's last-published root in O(1) instead of rehashing
+        // every leaf here. The version-stamp token rides along verbatim
+        // so the plane can stamp (and force-refresh) its answer.
         ClusterCallback cb;
         {
           std::lock_guard lk(cb_mu_);
           cb = cluster_cb_;
         }
         if (cb) {
-          std::string resp = cb("HASH");
+          std::string line = "HASH";
+          if (cmd.want_version || cmd.force_refresh) {
+            // Reconstruct the exact flag set: a force-only token (vs=02)
+            // must reach the cluster plane too, or its refresh silently
+            // no-ops on cluster nodes while working on bare ones.
+            int flags = (cmd.want_version ? 1 : 0) |
+                        (cmd.force_refresh ? 2 : 0);
+            line += " vs=0" + std::to_string(flags);
+          }
+          std::string resp = cb(line);
           if (!resp.empty()) {
             out.payload(std::move(resp));
             return;
           }
         }
       }
+      // Stamp read BEFORE the scan: a mutation landing mid-scan makes the
+      // root at least as fresh as the stamp, never staler than claimed.
+      uint64_t hash_ver = engine_->version();
       auto keys = engine_->scan(prefix);
       std::vector<std::pair<std::string, std::string>> items;
       items.reserve(keys.size());
@@ -1230,7 +1243,14 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
                             ? digest_hex(root)
                             : std::string(64, '0');
       if (pat.empty()) {
-        out.lit("HASH " + hex + "\r\n");
+        if (cmd.want_version) {
+          // Live-engine answer: the stamp is the version it reflects and
+          // the lag is 0 by construction.
+          out.lit("HASH " + hex + " " + std::to_string(hash_ver) +
+                  " 0\r\n");
+        } else {
+          out.lit("HASH " + hex + "\r\n");
+        }
       } else {
         out.lit("HASH " + pat + " " + hex + "\r\n");
       }
@@ -1323,6 +1343,10 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
       return;
     }
     case Verb::LeafHashes: {
+      // Stamp read BEFORE the scan (conservative — same rule as HASH).
+      // LEAFHASHES reads the live engine, so lag is 0 and only the
+      // version rides the stamped header.
+      uint64_t leaf_ver = engine_->version();
       auto keys = engine_->scan(cmd.prefix);
       std::string body;
       size_t listed = 0;
@@ -1351,7 +1375,12 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
         body += k + " - " + std::to_string(ts) + "\r\n";
         ++listed;
       }
-      out.lit("HASHES " + std::to_string(listed) + "\r\n");
+      if (cmd.want_version) {
+        out.lit("HASHES " + std::to_string(listed) + " " +
+                std::to_string(leaf_ver) + "\r\n");
+      } else {
+        out.lit("HASHES " + std::to_string(listed) + "\r\n");
+      }
       out.payload(std::move(body));
       return;
     }
@@ -1365,6 +1394,8 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
       // Fewer lines than requested means the keyspace is exhausted.
       const std::string& after = cmd.prefix;
       const int64_t want = cmd.amount.value_or(1);
+      // Stamp read before the page selection (live engine, lag 0).
+      uint64_t page_ver = engine_->version();
       // page_between is the engine's bounded top-k selection: O(N log page)
       // per request instead of materializing + sorting the whole keyspace
       // for every page of the walk (which made one full paged walk
@@ -1403,7 +1434,12 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
           ++listed;
         }
       }
-      out.lit("HASHES " + std::to_string(listed) + "\r\n");
+      if (cmd.want_version) {
+        out.lit("HASHES " + std::to_string(listed) + " " +
+                std::to_string(page_ver) + "\r\n");
+      } else {
+        out.lit("HASHES " + std::to_string(listed) + "\r\n");
+      }
       out.payload(std::move(body));
       return;
     }
@@ -1422,9 +1458,16 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
         cb = cluster_cb_;
       }
       if (cb) {
-        std::string resp = cb("TREELEVEL " + std::to_string(cmd.level) +
-                              " " + std::to_string(cmd.lo) + " " +
-                              std::to_string(cmd.hi));
+        std::string line = "TREELEVEL " + std::to_string(cmd.level) + " " +
+                           std::to_string(cmd.lo) + " " +
+                           std::to_string(cmd.hi);
+        if (cmd.want_version || cmd.force_refresh) {
+          // Exact flag reconstruction — see the HASH relay above.
+          int flags = (cmd.want_version ? 1 : 0) |
+                      (cmd.force_refresh ? 2 : 0);
+          line += " vs=0" + std::to_string(flags);
+        }
+        std::string resp = cb(line);
         if (!resp.empty()) {
           out.payload(std::move(resp));
           return;
@@ -1441,12 +1484,13 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
       // holding tree_mu_. Serving one CONSISTENT tree for the TTL is also
       // what a mid-walk peer needs — per-request rebuilds would shift the
       // leaf count between its fetches and abort the walk as churn. The
-      // walk tolerates the bounded staleness by design (next cycle's root
-      // compare re-verifies).
+      // walk tolerates the bounded staleness by design (the reply's
+      // version stamp tells it exactly how far the tree trails; a
+      // force_refresh token overrides the TTL for an exact answer).
       constexpr auto kServeStale = std::chrono::seconds(5);
       const auto now = std::chrono::steady_clock::now();
       uint64_t v = engine_->version();
-      if (!tree_valid_ ||
+      if (!tree_valid_ || cmd.force_refresh ||
           (v != tree_version_ && now - tree_built_ > kServeStale)) {
         tree_levels_ = merkle_levels(engine_->snapshot());
         tree_version_ = v;
@@ -1467,8 +1511,17 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
           ++count;
         }
       }
-      out.lit("NODES " + std::to_string(count) + " " + std::to_string(n) +
-              "\r\n");
+      if (cmd.want_version) {
+        // Stamp = the engine version the CACHED tree reflects; lag = how
+        // far the live engine has moved past it (0 right after a rebuild).
+        uint64_t lag = v >= tree_version_ ? v - tree_version_ : 0;
+        out.lit("NODES " + std::to_string(count) + " " + std::to_string(n) +
+                " " + std::to_string(tree_version_) + " " +
+                std::to_string(lag) + "\r\n");
+      } else {
+        out.lit("NODES " + std::to_string(count) + " " + std::to_string(n) +
+                "\r\n");
+      }
       out.payload(std::move(body));
       return;
     }

@@ -172,6 +172,26 @@ void pipelined_worker(uint16_t port, int tid, int bursts, int depth) {
   ::close(fd);
 }
 
+// Device-pump analog: the control plane's pump loop hammers version reads
+// and tree serving (TREELEVEL host cache under tree_mu_, stamped + forced
+// forms, stamped HASH rebuilds) while the io workers dispatch writes — the
+// exact overlap the bounded-staleness serving path produces in production.
+void pump_worker(uint16_t port, int iters) {
+  int fd = connect_to(port);
+  if (fd < 0) return;
+  for (int i = 0; i < iters; ++i) {
+    const char* cmd;
+    switch (i % 4) {
+      case 0: cmd = "TREELEVEL 0 0 4 vs=01"; break;
+      case 1: cmd = "HASH vs=01"; break;
+      case 2: cmd = "TREELEVEL 0 0 4 vs=03"; break;  // forced rebuild
+      default: cmd = "LEAFHASHES vs=01"; break;
+    }
+    if (!round_trip(fd, cmd)) break;
+  }
+  ::close(fd);
+}
+
 void slow_reader_worker(uint16_t port, int gets) {
   int fd = connect_to(port);
   if (fd < 0) return;
@@ -231,6 +251,10 @@ void stress_pipelined_pool() {
     clients.emplace_back(pipelined_worker, server.port(), t, 40, 32);
   }
   clients.emplace_back(slow_reader_worker, server.port(), 200);
+  // Two pump threads: forced TREELEVEL rebuilds + stamped HASH/LEAFHASHES
+  // racing the write storm and each other over tree_mu_ / engine version.
+  clients.emplace_back(pump_worker, server.port(), 200);
+  clients.emplace_back(pump_worker, server.port(), 200);
   for (auto& t : clients) t.join();
   draining.store(false, std::memory_order_release);
   drainer.join();

@@ -15,7 +15,8 @@ complement of the live ``top``/``trace`` surfaces:
 
 - **anomalies**: degradation-ladder flips, storage full latches,
   peer-health flips, sync-cycle errors, slow-command bursts (>= 3 within
-  10 s), skew-clamp bursts, admission-rejection bursts, and lag spikes
+  10 s), skew-clamp bursts, admission-rejection bursts, device-tree
+  staleness breaches (wedged update pump), and lag spikes
   from the sampled ``replication.lag_events.*`` series.
 
 - **fatal context**: ``fatal.txt`` crash markers (native signal stamps)
@@ -276,6 +277,13 @@ def find_anomalies(
         elif ev.kind == "skew_clamp":
             add(e, "skew_clamp",
                 f"{f.get('count')} events from {f.get('srcs')}")
+        elif ev.kind == "tree_staleness":
+            # The device-update pump breached its [device] max_staleness
+            # contract (or stalled outright) — a wedged device queue.
+            add(e, "tree_staleness",
+                f"pump lag {f.get('lag_ms')}ms / "
+                f"{f.get('lag_versions')} versions "
+                f"(window {f.get('window_ms')}ms)")
         elif ev.kind in ("admission_reject", "pipeline_reject",
                          "events_dropped"):
             add(e, "rejection_burst", f"{ev.kind} +{f.get('count')}")

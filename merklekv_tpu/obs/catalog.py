@@ -69,6 +69,13 @@ CATALOG: dict[str, tuple[str, str]] = {
         "counter", "Merkle tree nodes compared during bisection walks."),
     "sync.rounds": (
         "counter", "Bisection-walk level rounds (TREELEVEL batches)."),
+    "sync.walk_clips": (
+        "counter", "Bisection walks clipped to their verified frontier "
+        "after a stamped donor republished mid-walk (bounded trailing "
+        "absorbed instead of abandoning the walk)."),
+    "sync.forced_refreshes": (
+        "counter", "Walk probes escalated to a forced donor tree refresh "
+        "(donor-reported lag exceeded the staleness limit)."),
     # -- replication -------------------------------------------------------
     "replicator.published": (
         "counter", "Replication events published to the fabric."),
@@ -139,6 +146,19 @@ CATALOG: dict[str, tuple[str, str]] = {
         "histogram", "Scatter-batch dispatch (async enqueue) latency."),
     "device.restructure_dispatch": (
         "histogram", "Structural-batch dispatch (async enqueue) latency."),
+    "device.pump_batches": (
+        "counter", "Device-update pump drain cycles published (staged "
+        "events -> scatter dispatch -> served snapshot)."),
+    "device.pump_errors": (
+        "counter", "Pump drains that failed (state invalidated; queries "
+        "fall back native and a re-warm respawns the pump)."),
+    "device.pump_lag_versions": (
+        "gauge", "Engine mutations staged but not yet published by the "
+        "pump (the versions half of the [device] max_staleness contract; "
+        "-1: no mirror)."),
+    "device.pump_lag_ms": (
+        "gauge", "Milliseconds the oldest staged-but-unpublished change "
+        "has waited on the pump (0: caught up; -1: no mirror)."),
     "profiler.captures": (
         "counter", "PROFILE verb device-profiler captures started."),
     # -- flight recorder ---------------------------------------------------

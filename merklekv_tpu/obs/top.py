@@ -5,10 +5,12 @@ wire protocol (no exporter needed), computes per-interval rates from
 successive counter samples, and renders one table per refresh:
 
     NODE  KEYS  OPS/S  SET/S  GET/S  P50_US  SYNC_KB/S  CONNS  W  OPS/S/W
-    PEERS_UP  LAG_EV  LAG_MS  READY  STATE  SHED/S  STATUS
+    PEERS_UP  LAG_EV  LAG_MS  STALE  VER  READY  STATE  SHED/S  STATUS
 
 (CONNS = active connections; W = epoll worker-pool width; OPS/S/W = the
-busiest io worker's command rate, the pool-imbalance signal.)
+busiest io worker's command rate, the pool-imbalance signal; STALE = the
+device pump's worst lag in ms; VER = engine-vs-served tree version delta —
+how many mutations the served Merkle tree trails live by.)
 
 ``--once`` prints a single frame (two quick samples for rates) and exits —
 scriptable and testable; without it the screen refreshes every
@@ -63,6 +65,14 @@ class NodeSample:
     # STATE and SHED/s columns ("-" on nodes predating the ladder).
     state: str = "-"
     shed_total: int = 0
+    # Device freshness plane (METRICS device.pump_lag_ms /
+    # device.tree_version / node.engine_version lines): worst pump lag in
+    # ms and the engine-vs-served tree version delta — rendered as the
+    # STALE and VER columns (-1 / "-" on nodes without a device mirror or
+    # predating the pump).
+    pump_lag_ms: int = -1
+    tree_version: int = -1
+    engine_version: int = -1
     # io plane (STATS io_threads / io_worker_<i>_commands lines): pool
     # width and per-worker cumulative command counts — rendered as the W
     # and OPS/S/W (busiest worker's rate) columns ("-" on nodes predating
@@ -159,6 +169,15 @@ def sample_node(
         s.shed_total = int(metrics.get("node.shed_total", 0) or 0)
     except ValueError:
         pass
+    for attr, key in (
+        ("pump_lag_ms", "device.pump_lag_ms"),
+        ("tree_version", "device.tree_version"),
+        ("engine_version", "node.engine_version"),
+    ):
+        try:
+            setattr(s, attr, int(metrics[key]))
+        except (KeyError, ValueError):
+            pass  # node predates the pump (or has no mirror)
     for name, value in metrics.items():
         try:
             if name.startswith("replication.lag_events."):
@@ -212,7 +231,8 @@ def render_table(
         f"{'NODE':<22} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} {'GET/S':>8} "
         f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONNS':>5} {'W':>3} "
         f"{'OPS/S/W':>8} {'PEERS_UP':>9} "
-        f"{'LAG_EV':>7} {'LAG_MS':>8} {'READY':>8} {'STATE':>9} "
+        f"{'LAG_EV':>7} {'LAG_MS':>8} {'STALE':>6} {'VER':>5} "
+        f"{'READY':>8} {'STATE':>9} "
         f"{'SHED/S':>7} STATUS"
     )
     lines = [header, "-" * len(header)]
@@ -223,7 +243,8 @@ def render_table(
             lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
                          f"{'-':>7} {'-':>10} {'-':>5} {'-':>3} {'-':>8} "
                          f"{'-':>9} "
-                         f"{'-':>7} {'-':>8} {'-':>8} {'-':>9} {'-':>7} "
+                         f"{'-':>7} {'-':>8} {'-':>6} {'-':>5} "
+                         f"{'-':>8} {'-':>9} {'-':>7} "
                          f"DOWN ({c.error})")
             continue
         dt = (c.unix - p.unix) if (p is not None and p.ok) else 0.0
@@ -248,11 +269,20 @@ def render_table(
             f"{c.peers_up}/{c.peers_total}" if c.peers_total else "-"
         )
         w = str(c.io_threads) if c.io_threads else "-"
+        # STALE = worst device pump lag (ms); VER = engine-vs-served tree
+        # version delta. "-" on nodes without a device mirror.
+        stale = f"{c.pump_lag_ms}" if c.pump_lag_ms >= 0 else "-"
+        ver = (
+            f"{max(0, c.engine_version - c.tree_version)}"
+            if c.tree_version >= 0 and c.engine_version >= 0
+            else "-"
+        )
         lines.append(
             f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
             f"{p50:>7} {sync_kb:>10.1f} {c.active_connections:>5} "
             f"{w:>3} {per_worker:>8.1f} "
             f"{peers:>9} {c.lag_events:>7} {c.lag_ms:>8.1f} "
+            f"{stale:>6} {ver:>5} "
             f"{c.readiness:>8} {c.state:>9} {shed:>7.1f} UP"
         )
     return "\n".join(lines)

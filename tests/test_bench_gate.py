@@ -64,6 +64,13 @@ def test_direction_rules():
     )
     assert not bench_gate.lower_is_better("overload_goodput",
                                           "ops/s (accepted)")
+    # Asynchronous-maintenance scenario gates on write p99: LOWER is
+    # better — the pump path's latency regressing toward force-on-query
+    # cost is exactly what the gate must catch.
+    assert bench_gate.lower_is_better(
+        "tree_freshness_write_p99_us",
+        "us (SET p99 under concurrent TREELEVEL load, pump path)",
+    )
 
 
 def test_compare_flags_only_real_regressions():

@@ -102,7 +102,9 @@ def test_value_updates_are_incremental_after_warm(node):
     # Value updates of existing keys: incremental scatter path only.
     for i in range(8):
         node.client.set(f"ik{i:03d}", f"updated-{i}")
-    root = node.cluster.device_root_hex()
+    # force=True: drain the write stream through the pump first — the
+    # unforced path serves the last-published snapshot (bounded staleness).
+    root = node.cluster.device_root_hex(force=True)
     assert root == node.engine.merkle_root().hex()
     assert state.full_rebuilds == rebuilds_before
     assert state.incremental_batches >= 1
@@ -111,9 +113,9 @@ def test_value_updates_are_incremental_after_warm(node):
 def test_truncate_invalidates_mirror(node):
     node.client.set("gone", "soon")
     _wait_ready(node)
-    assert node.cluster.device_root_hex() != "0" * 64
+    assert node.cluster.device_root_hex(force=True) != "0" * 64
     node.client.flushdb()
-    assert node.cluster.device_root_hex() == "0" * 64
+    assert node.cluster.device_root_hex(force=True) == "0" * 64
     assert node.client.hash() == "0" * 64
 
 
@@ -130,8 +132,13 @@ def test_remote_applies_feed_mirror(broker):
                 break
             time.sleep(0.02)
         assert n2.client.get("replicated") == "value"
-        # n2's device root includes the remotely applied write.
-        assert n2.cluster.device_root_hex() == n2.engine.merkle_root().hex()
+        # n2's device root includes the remotely applied write (force
+        # publishes the staged frame; the unforced path trails by at most
+        # the staleness window).
+        assert (
+            n2.cluster.device_root_hex(force=True)
+            == n2.engine.merkle_root().hex()
+        )
     finally:
         n1.close()
         n2.close()
@@ -151,13 +158,16 @@ def test_sync_repairs_feed_mirror(broker):
             peer_eng.set(b"sync-only", b"via-anti-entropy")
             n1.client.set("own", "write")
             _wait_ready(n1)
-            assert n1.cluster.device_root_hex() == n1.engine.merkle_root().hex()
+            assert (
+                n1.cluster.device_root_hex(force=True)
+                == n1.engine.merkle_root().hex()
+            )
             # SYNC pulls sync-only in through the engine bindings.
             assert n1.client.sync_with("127.0.0.1", peer_srv.port)
             assert n1.client.get("sync-only") == "via-anti-entropy"
-            # The warm mirror must reflect the repair immediately.
+            # The warm mirror must reflect the repair after a pump drain.
             assert (
-                n1.cluster.device_root_hex()
+                n1.cluster.device_root_hex(force=True)
                 == n1.engine.merkle_root().hex()
             )
         finally:

@@ -551,7 +551,9 @@ def test_frame_of_k_writes_is_one_mirror_dispatch(pair):
     )
     n2.cluster.replicator._on_message("t", frame)
     assert n2.engine.get(b"dk00") == b"v1"
-    root = n2.cluster.device_root_hex()  # flushes the staged frame
+    # force=True publishes the staged frame through the pump (the unforced
+    # path serves the previous snapshot until the pump's next cycle).
+    root = n2.cluster.device_root_hex(force=True)
     assert st.incremental_batches == base_inc + 1  # ONE scatter program
     assert st.structural_batches == base_struct
     assert root == n2.engine.merkle_root().hex()

@@ -114,13 +114,16 @@ def test_cluster_node_serves_sharded_root():
             time.sleep(0.02)
         assert node._mirror.ready(), "sharded mirror never warmed"
         # Warm path: served from the SHARDED device tree.
-        assert node.device_root_hex() == native_root
-        assert client.hash() == native_root
+        assert node.device_root_hex(force=True) == native_root
+        client.version_stamps = True
+        client.tree_level(0, 0, 0)  # settle the stamp capability
+        assert client.hash(force=True) == native_root
         leaf_sharding = node._mirror.state._levels[0].sharding
         assert not leaf_sharding.is_fully_replicated
-        # Writes keep flowing through the sharded incremental path.
+        # Writes keep flowing through the sharded incremental path; the
+        # forced HASH drains the pump so the answer is exact.
         client.set("shk000", "updated")
-        assert client.hash() == engine.merkle_root().hex()
+        assert client.hash(force=True) == engine.merkle_root().hex()
     finally:
         client.close()
         node.stop()
