@@ -44,6 +44,12 @@ The remaining BASELINE.json configs print one JSON line each on STDERR
     snapshot vs force-on-query vs tree-maintenance-off, with the measured
     max staleness vs the [device] window and a bit-identical root check
     once the window closes; down-good.
+  - sharded_rebuild_diff_keys_per_s: sharded device Merkle plane — full
+    rebuild of the serving ShardedDeviceMerkleState (per-shard subtree
+    reduce + all_gather top tree) plus an 8-replica diff through the
+    merkle/diff.py engine boundary, A/B vs the single-device path with a
+    bit-identical root assert (keys x devices; a 1-device backend runs the
+    sweep on a delegated 8-way host mesh); up-good.
 
 Off-TPU the sizes shrink to smoke-test values so the script stays runnable
 in CI; the driver's real run happens on the chip.
@@ -1335,6 +1341,129 @@ def bench_diff64(n: int, reps: int) -> dict:
     }
 
 
+def _sharded_rebuild_diff_core(n: int, replicas: int) -> dict:
+    """Sweep body: sharded rebuild + N-replica diff vs single-device A/B
+    (runs either in-process on a multi-device backend or inside the
+    delegated host-mesh subprocess)."""
+    import jax
+
+    from merklekv_tpu.merkle.diff import (
+        divergence_masks,
+        divergence_masks_engine,
+    )
+    from merklekv_tpu.merkle.incremental import DeviceMerkleState
+    from merklekv_tpu.parallel.sharded_state import ShardedDeviceMerkleState
+
+    keys, values = _make_kv(n)
+    items = list(zip(keys, values))
+    rng = np.random.RandomState(5)
+    base = rng.randint(0, 2**32, size=(1, n, 8), dtype=np.uint64).astype(
+        np.uint32
+    )
+    digests = np.tile(base, (replicas, 1, 1))
+    for r in range(1, replicas):
+        digests[r, rng.randint(0, n, size=max(1, n // 100))] ^= np.uint32(r)
+    present = np.ones((replicas, n), bool)
+    from merklekv_tpu.parallel.sharded_state import resolve_shard_count
+
+    # LOCAL devices, auto policy — the same mesh the serving state and the
+    # diff engine boundary would resolve (floored at a 1-device mesh).
+    d = max(1, resolve_shard_count("auto", len(jax.local_devices())))
+    diff_single = jax.jit(divergence_masks)
+
+    def one_pass(sharded: bool) -> tuple[str, float]:
+        t0 = time.perf_counter()
+        st = (
+            ShardedDeviceMerkleState.from_items(items, shards=d)
+            if sharded
+            else DeviceMerkleState.from_items(items)
+        )
+        root = st.root_hex()
+        masks = (
+            divergence_masks_engine(digests, present, min_keys=0)
+            if sharded
+            else diff_single(digests, present)
+        )
+        assert int(np.asarray(masks).sum()) > 0  # host fetch syncs the diff
+        return root, time.perf_counter() - t0
+
+    # Warm both paths (kernel compiles), then time one full pass each.
+    one_pass(True)
+    one_pass(False)
+    root_sh, dt_sh = one_pass(True)
+    root_single, dt_single = one_pass(False)
+    assert root_sh == root_single, "sharded root != single-device root"
+    return {
+        "metric": "sharded_rebuild_diff_keys_per_s",
+        "value": round(n / dt_sh, 1),
+        "unit": "keys/s",
+        "n": n,
+        "replicas": replicas,
+        "devices": d,
+        "single_device_keys_per_s": round(n / dt_single, 1),
+        "speedup_vs_single": round(dt_single / dt_sh, 2),
+        "roots_match": True,
+    }
+
+
+def bench_sharded_rebuild_diff(n_keys: int, replicas: int = 8) -> dict:
+    """Sharded device Merkle plane (ISSUE 12): full rebuild of the SERVING
+    tree (ShardedDeviceMerkleState — per-shard subtree reduce + all_gather
+    top tree) plus an N-replica diff through the merkle/diff.py engine
+    boundary, A/B'd against the single-device path, with a bit-identical
+    root assert. keys/s, up-good for bench_gate.
+
+    A 1-device backend (the usual tunneled chip) delegates the sweep to a
+    subprocess provisioning a virtual 8-device CPU host mesh — the same
+    recipe as dryrun_multichip — so the record always carries a real
+    multi-shard measurement."""
+    import subprocess
+
+    import jax
+
+    if len(jax.devices()) >= 2:
+        out = _sharded_rebuild_diff_core(n_keys, replicas)
+        out["mesh_backend"] = "in-process"
+        return out
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = "\n".join(
+        [
+            "import json, sys",
+            "import jax",
+            "jax.config.update('jax_platforms', 'cpu')",
+            f"sys.path.insert(0, {here!r})",
+            "import bench",
+            f"print(json.dumps(bench._sharded_rebuild_diff_core("
+            f"{n_keys}, {replicas})))",
+        ]
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=here,
+    )
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"host-mesh sweep failed rc={res.returncode}: "
+            f"{res.stderr[-800:]}"
+        )
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    out["mesh_backend"] = "cpu-host-mesh"
+    return out
+
+
 def _metrics_blob() -> dict:
     """Counters + span aggregates at this instant (cumulative within the
     run) — embedded in every emitted JSON record. Histogram buckets are
@@ -1474,6 +1603,14 @@ def _run(backend: str) -> None:
     except Exception as e:
         print(f"# tree_freshness_write_storm bench failed: {e!r}",
               file=sys.stderr)
+    try:
+        configs.append(
+            bench_sharded_rebuild_diff(
+                n_keys=(1 << 20) if on_tpu else (1 << 13)
+            )
+        )
+    except Exception as e:
+        print(f"# sharded_rebuild_diff bench failed: {e!r}", file=sys.stderr)
 
     # Every emitted record carries the run's metrics snapshot (counters +
     # span aggregates) so a BENCH_*.json trajectory shows what the run

@@ -68,12 +68,18 @@ class DeviceTreeMirror:
         sharded: bool = False,
         max_staleness_ms: float = 200.0,
         max_staleness_versions: int = 0,
+        sharding: str = "off",
     ) -> None:
         self._engine = engine
-        # Shard the device tree's leaf level over ALL local JAX devices
-        # (GSPMD over a "key" mesh) instead of living on one chip — the
-        # serving-path integration of the SPMD program (SURVEY §2.4).
-        self._sharded = sharded
+        # Serving-tree backend selection ([device] sharding = auto|off|N):
+        # "off" keeps the single-device DeviceMerkleState; anything else
+        # resolves to a ShardedDeviceMerkleState over a power-of-two mesh
+        # of LOCAL devices — per-shard subtree rebuilds in parallel, shard
+        # roots combined via the all_gather top tree, answers bit-identical
+        # to the single-device tree. ``sharded`` is the deprecated boolean
+        # alias (== "auto").
+        mode = str(sharding).strip().lower()
+        self._sharding_mode = "auto" if (sharded and mode == "off") else mode
         self._mu = threading.RLock()
         self._state = None  # lazy: built from an engine snapshot on first use
         self._warming = threading.Event()
@@ -167,8 +173,7 @@ class DeviceTreeMirror:
                         # own event with a higher watermark.
                         v0 = self._engine.version()
                         items = self._engine.snapshot()
-                    cls = self._device_state_cls()
-                    st = cls.from_items(items, sharding=self._make_sharding())
+                    st = self._build_state(items)
                     # Pay the build + kernel-compile cost HERE so the first
                     # post-warm HASH answers immediately.
                     st.root_hex()
@@ -564,44 +569,64 @@ class DeviceTreeMirror:
         return self._state
 
     # -- internals -----------------------------------------------------------
-    @staticmethod
-    def _device_state_cls():
+    def _resolve_shards(self) -> int:
+        """[device] sharding -> shard count (0 = single-device backend).
+        Resolved at state-build time against the LOCAL device complement:
+        the mirror is a per-node structure driven by this node's event
+        stream, not a cross-host SPMD program — under a multi-host jax
+        cluster (parallel/multihost.py) jax.devices() includes other hosts'
+        non-addressable chips, and a device_put onto those would fail or
+        deadlock."""
         # Honor MERKLEKV_JAX_PLATFORM before the first device use (not at
         # module import): N spawned servers must not race for a
         # single-process accelerator backend.
         from merklekv_tpu.utils.jaxenv import ensure_platform
 
         ensure_platform()
-        from merklekv_tpu.merkle.incremental import DeviceMerkleState
-
-        return DeviceMerkleState
-
-    def _make_sharding(self):
-        """NamedSharding over local devices ("key" mesh) when sharded
-        serving is on; None for the single-device tree. Non-power-of-two
-        device counts mesh the largest power-of-two subset — the padded
-        tree's capacity is a power of two and must divide evenly."""
-        if not self._sharded:
-            return None
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec
 
-        from merklekv_tpu.parallel.mesh import make_mesh
+        from merklekv_tpu.parallel.sharded_state import resolve_shard_count
 
-        # LOCAL devices only: the mirror is a per-node structure driven by
-        # this node's event stream, not an SPMD program — under a
-        # multi-host jax cluster (parallel/multihost.py) jax.devices()
-        # includes other hosts' non-addressable chips, and a device_put
-        # onto those would fail or deadlock.
-        devs = jax.local_devices()
-        n = 1 << (len(devs).bit_length() - 1)  # largest pow2 <= len(devs)
-        mesh = make_mesh({"key": n}, devices=devs[:n])
-        return NamedSharding(mesh, PartitionSpec("key", None))
-
-    def _load_state(self):
-        return self._device_state_cls().from_items(
-            self._engine.snapshot(), sharding=self._make_sharding()
+        return resolve_shard_count(
+            self._sharding_mode, len(jax.local_devices())
         )
 
+    def _build_state(self, items):
+        """State factory — the pluggable backend seam. The pump, staging,
+        and every query path drive whichever state this returns through the
+        identical DeviceMerkleState surface."""
+        d = self._resolve_shards()
+        if d <= 0:
+            from merklekv_tpu.merkle.incremental import DeviceMerkleState
+
+            return DeviceMerkleState.from_items(items)
+        from merklekv_tpu.parallel.sharded_state import (
+            ShardedDeviceMerkleState,
+        )
+
+        return ShardedDeviceMerkleState.from_items(items, shards=d)
+
+    def _load_state(self):
+        return self._build_state(self._engine.snapshot())
+
     def _empty_state(self):
-        return self._device_state_cls()(sharding=self._make_sharding())
+        return self._build_state(())
+
+    def shard_count(self) -> int:
+        """Device shards serving the built tree (1 = single-device state;
+        -1 while warming/closed) — the ``device.shards`` gauge."""
+        with self._mu:
+            st = self._state
+            if self._closed or st is None:
+                return -1
+            return int(getattr(st, "_n_shards", 1))
+
+    def shard_rebuild_us(self) -> int:
+        """Dispatch cost of the last sharded subtree rebuild in
+        microseconds (-1: single-device backend or none yet) — the
+        ``device.shard_rebuild_us`` gauge. Lock-free like pump_lag_ms: a
+        monitoring read must never park behind a device dispatch."""
+        st = self._state
+        if st is None:
+            return -1
+        return int(getattr(st, "last_shard_rebuild_us", -1))

@@ -338,6 +338,7 @@ class ClusterNode:
                 self._mirror = DeviceTreeMirror(
                     self._engine,
                     sharded=self._cfg.device.sharded_mirror,
+                    sharding=self._cfg.device.sharding,
                     max_staleness_ms=self._cfg.device.max_staleness_ms,
                     max_staleness_versions=(
                         self._cfg.device.max_staleness_versions
@@ -757,6 +758,16 @@ class ClusterNode:
                 int(round(mirror.pump_lag_ms())) if mirror is not None else -1
             )
 
+        def mirror_shards() -> int:
+            with self._rep_mu:
+                mirror = self._mirror
+            return mirror.shard_count() if mirror is not None else -1
+
+        def shard_rebuild_us() -> int:
+            with self._rep_mu:
+                mirror = self._mirror
+            return mirror.shard_rebuild_us() if mirror is not None else -1
+
         def outbox_depth() -> int:
             t = self._transport
             return getattr(t, "outbox_depth", 0) if t is not None else 0
@@ -796,6 +807,14 @@ class ClusterNode:
              "Milliseconds the oldest staged-but-unpublished device-tree "
              "change has waited on the pump (0: caught up; -1: no "
              "mirror).", ""),
+            ("device.shards", mirror_shards,
+             "Device shards serving the Merkle tree's leaf level "
+             "([device] sharding; 1: single-device tree; -1: no mirror or "
+             "warming).", ""),
+            ("device.shard_rebuild_us", shard_rebuild_us,
+             "Dispatch cost of the last sharded subtree rebuild in "
+             "microseconds (async enqueue; -1: single-device backend or "
+             "no rebuild yet).", ""),
             ("replication.outbox_depth", outbox_depth,
              "Events queued in the transport outbox awaiting a broker "
              "heal.", ""),
@@ -905,6 +924,7 @@ class ClusterNode:
                 lines.append(
                     f"device.tree_version:{mirror.published_version()}"
                 )
+                lines.append(f"device.shards:{mirror.shard_count()}")
                 if self._engine._h:
                     lines.append(
                         f"node.engine_version:{self._engine.version()}"

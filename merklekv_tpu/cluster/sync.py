@@ -1603,7 +1603,7 @@ class SyncManager:
 
         from merklekv_tpu.merkle.diff import (
             align_replicas,
-            divergence_masks,
+            divergence_masks_engine,
             divergence_masks_np,
         )
 
@@ -1753,8 +1753,13 @@ class SyncManager:
                     from merklekv_tpu.utils.jaxenv import ensure_platform
 
                     ensure_platform()
+                    # Engine boundary: the N-replica comparison shards over
+                    # the local device mesh when one exists and the union
+                    # keyspace amortizes it (bit-identical masks).
                     masks = np.asarray(
-                        divergence_masks(aligned.digests, aligned.present)
+                        divergence_masks_engine(
+                            aligned.digests, aligned.present
+                        )
                     )
                 except Exception as e:
                     jaxenv.note_device_failure(e, "divergence masks")

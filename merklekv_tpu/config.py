@@ -336,9 +336,17 @@ class ObservabilityConfig:
 
 @dataclass
 class DeviceConfig:
-    # Shard the serving Merkle tree's leaf level over ALL local JAX devices
-    # (GSPMD over a "key" mesh). Single-device trees are the default; on a
-    # multi-chip host this spreads HBM and the rebuild across chips.
+    # Serving-tree shard plane (parallel/sharded_state.py): "off" keeps the
+    # single-device tree; "auto" shards the keyspace-ordered leaf array
+    # across the largest power-of-two subset of the LOCAL devices (per-shard
+    # subtree rebuilds in parallel, shard roots combined via all_gather); an
+    # explicit power-of-two N pins the mesh width (clamped, with a warning,
+    # to the device complement). TREELEVEL/HASH answers are bit-identical
+    # at every setting — see docs/DEPLOYMENT.md "Mesh sizing".
+    sharding: str = "off"
+    # Deprecated alias ([device] sharded_mirror = true == sharding = "auto"):
+    # the pre-sharding-knob GSPMD toggle, honored one release for configs
+    # that predate the explicit SPMD backend.
     sharded_mirror: bool = False
     # Freshness contract of the device-update pump (cluster/mirror.py):
     # the served tree trails the live engine by at most this wall window.
@@ -495,6 +503,21 @@ class Config:
         dev = raw.get("device", {})
         if "sharded_mirror" in dev:
             cfg.device.sharded_mirror = bool(dev["sharded_mirror"])
+        if "sharding" in dev:
+            # auto|off|N (TOML may carry the N as an integer or a string).
+            cfg.device.sharding = str(dev["sharding"]).strip().lower()
+        elif cfg.device.sharded_mirror:
+            cfg.device.sharding = "auto"  # deprecated-alias promotion
+        if cfg.device.sharding not in ("auto", "off"):
+            try:
+                n_shards = int(cfg.device.sharding)
+            except ValueError:
+                n_shards = -1
+            if n_shards < 1 or n_shards & (n_shards - 1):
+                raise ValueError(
+                    "[device] sharding must be auto|off|power-of-two, got "
+                    f"{cfg.device.sharding!r}"
+                )
         if "max_staleness_ms" in dev:
             cfg.device.max_staleness_ms = float(dev["max_staleness_ms"])
         if "max_staleness_versions" in dev:

@@ -27,9 +27,15 @@ __all__ = [
     "align_replicas",
     "divergence_vs_ref",
     "divergence_masks",
+    "divergence_masks_engine",
     "diff_keys_multi",
     "diff_keys_pair",
 ]
+
+# Route the [R, N] comparison through the keyspace-sharded SPMD program
+# only past this union-keyspace size: below it the collective setup costs
+# more than the elementwise pass it parallelizes.
+SHARDED_DIFF_MIN_KEYS = 1 << 15
 
 
 class AlignedReplicas:
@@ -102,6 +108,65 @@ def divergence_masks_np(digests: np.ndarray, present: np.ndarray) -> np.ndarray:
     initializing an accelerator backend is not worth it (and, in spawned
     server processes, must be avoided unless explicitly configured)."""
     return divergence_vs_ref(digests, present, digests[0:1], present[0:1])
+
+
+def _local_diff_mesh():
+    """One-axis ``key`` mesh over the largest power-of-two local-device
+    subset, or None on a single-device host. Deferred import: parallel/
+    imports this module, so the dependency must stay call-time."""
+    from merklekv_tpu.parallel.mesh import make_mesh
+    from merklekv_tpu.parallel.sharded_state import resolve_shard_count
+
+    devs = jax.local_devices()
+    n = resolve_shard_count("auto", len(devs))  # 0 on a 1-device host
+    if n < 2:
+        return None
+    return make_mesh({"key": n}, devices=devs[:n])
+
+
+def divergence_masks_engine(
+    digests, present, min_keys: Optional[int] = None
+) -> jax.Array:
+    """The N-replica diff behind the engine boundary.
+
+    Routes the ``[R, N]`` comparison through the keyspace-sharded SPMD
+    program (``parallel.sharded_merkle.sharded_divergence``) when the host
+    has a multi-device mesh and the union keyspace amortizes the
+    collectives; single-device :func:`divergence_masks` otherwise. Masks
+    are bit-identical either way. The key axis is padded up to the mesh
+    axis with all-absent columns (absent everywhere == absent on the
+    reference -> never divergent) and sliced back off.
+
+    ``min_keys`` overrides :data:`SHARDED_DIFF_MIN_KEYS` (0 forces the
+    sharded path whenever a mesh exists — tests and the bench sweep).
+    """
+    n = int(digests.shape[1])
+    lim = SHARDED_DIFF_MIN_KEYS if min_keys is None else min_keys
+    mesh = None
+    if n > 0 and n >= lim:
+        try:
+            mesh = _local_diff_mesh()
+        except Exception:
+            mesh = None
+    if mesh is None:
+        return divergence_masks(digests, present)
+    from merklekv_tpu.parallel.sharded_merkle import sharded_divergence
+
+    d = int(mesh.shape["key"])
+    pad = (-n) % d
+    if pad:
+        dig = np.concatenate(
+            [np.asarray(digests),
+             np.zeros((digests.shape[0], pad, 8), np.uint32)], axis=1
+        )
+        pres = np.concatenate(
+            [np.asarray(present),
+             np.zeros((present.shape[0], pad), bool)], axis=1
+        )
+    else:
+        dig, pres = digests, present
+    masks, _counts = sharded_divergence(mesh, dig, pres)
+    return masks[:, :n] if pad else masks
 
 
 @jax.jit

@@ -398,7 +398,7 @@ class DeviceMerkleState:
             padded = np.zeros((c, 8), np.uint32)
             padded[:n] = digests
             rec["bytes"] = int(padded.nbytes)
-            self._levels = _build_fn(c, use_pallas())(self._put(padded))
+            self._levels = self._dispatch_build(padded)
         self._set_keys(keys_arr)
         self._capacity = c
         self.full_rebuilds += 1
@@ -468,10 +468,8 @@ class DeviceMerkleState:
         import time as _time
 
         t0 = _time.perf_counter()
-        fn = _restructure_fn(self._capacity, c_new, kb, use_pallas())
-        self._levels = fn(
-            self._levels[0], self._put(gather_padded, one_d=True),
-            jnp.asarray(fresh_pos), fresh,
+        self._levels = self._dispatch_restructure(
+            gather_padded, fresh_pos, fresh, kb, c_new
         )
         self._set_keys(new_keys)
         self._capacity = c_new
@@ -482,6 +480,31 @@ class DeviceMerkleState:
               int(gather_padded.nbytes + fresh_pos.nbytes + k * 32))
         # Dispatch latency, same async-enqueue semantics as scatter above.
         m.observe("device.restructure_dispatch", _time.perf_counter() - t0)
+
+    # ------------------------------------------------- device dispatch seam
+    # The host planning above (classification, permutation index arithmetic,
+    # packing) is backend-agnostic; only these two hooks touch a compiled
+    # device program. ShardedDeviceMerkleState (parallel/sharded_state.py)
+    # overrides them with explicit shard_map SPMD programs.
+    def _dispatch_build(self, padded: np.ndarray) -> tuple:
+        """Capacity-padded [C, 8] leaf digests -> every padded level."""
+        return _build_fn(len(padded), use_pallas())(self._put(padded))
+
+    def _dispatch_restructure(
+        self,
+        gather_padded: np.ndarray,
+        fresh_pos: np.ndarray,
+        fresh: jax.Array,
+        kb: int,
+        c_new: int,
+    ) -> tuple:
+        """Gather survivors into shifted slots + scatter fresh digests +
+        full re-reduction (``self._capacity`` still holds the OLD C)."""
+        fn = _restructure_fn(self._capacity, c_new, kb, use_pallas())
+        return fn(
+            self._levels[0], self._put(gather_padded, one_d=True),
+            jnp.asarray(fresh_pos), fresh,
+        )
 
     # ------------------------------------------------------------ queries
     def root_hash(self, flush: bool = True) -> Optional[bytes]:

@@ -295,6 +295,19 @@ def find_anomalies(
             and str(f.get("phase")) == "watchdog-timeout"
         ):
             add(e, "watchdog", str(f.get("stuck_in", "")))
+        elif (
+            ev.kind == "multichip_phase"
+            and str(f.get("phase")) in ("device-count", "device-enumerate")
+            and str(f.get("have", "")).isdigit()
+            and str(f.get("want", "")).isdigit()
+            and int(f["have"]) < int(f["want"])
+        ):
+            # Device-complement shortfall (MULTICHIP_r01's failure mode):
+            # surfaced as ENVIRONMENT weather so triage reads the cause
+            # directly instead of treating the round as a code regression
+            # (the probe's JSON record carries the matching error_kind).
+            add(e, "environment",
+                f"device shortfall: have {f['have']}, want {f['want']}")
         elif ev.kind == "slow_command":
             win = slow_recent.setdefault(e.node, [])
             win.append(ev.wall_ns)

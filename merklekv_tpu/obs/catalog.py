@@ -159,6 +159,21 @@ CATALOG: dict[str, tuple[str, str]] = {
     "device.pump_lag_ms": (
         "gauge", "Milliseconds the oldest staged-but-unpublished change "
         "has waited on the pump (0: caught up; -1: no mirror)."),
+    "device.shards": (
+        "gauge", "Device shards serving the Merkle tree's leaf level "
+        "([device] sharding; 1: single-device tree; -1: no mirror or "
+        "warming)."),
+    "device.shard_rebuild_us": (
+        "gauge", "Dispatch cost of the last sharded subtree rebuild in "
+        "microseconds (async enqueue; -1: single-device backend or no "
+        "rebuild yet)."),
+    "device.shard_batches": (
+        "counter", "Sharded-tree rebuild/restructure batches dispatched "
+        "over the key mesh (per-shard subtree reduce + all_gather top "
+        "tree)."),
+    "device.shard_rebuild_dispatch": (
+        "histogram", "Sharded subtree rebuild dispatch (async enqueue) "
+        "latency over the key mesh."),
     "profiler.captures": (
         "counter", "PROFILE verb device-profiler captures started."),
     # -- flight recorder ---------------------------------------------------

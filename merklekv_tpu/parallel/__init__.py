@@ -1,5 +1,5 @@
 """Multi-device SPMD layer: mesh construction, sharded Merkle build/diff,
-multi-host (DCN) bootstrap."""
+the sharded serving-tree state, multi-host (DCN) bootstrap."""
 
 from merklekv_tpu.parallel import multihost
 from merklekv_tpu.parallel.mesh import make_mesh
@@ -10,6 +10,10 @@ from merklekv_tpu.parallel.sharded_merkle import (
     sharded_divergence_2d,
     sharded_tree_root,
 )
+from merklekv_tpu.parallel.sharded_state import (
+    ShardedDeviceMerkleState,
+    resolve_shard_count,
+)
 
 __all__ = [
     "make_mesh",
@@ -19,4 +23,6 @@ __all__ = [
     "sharded_divergence_2d",
     "sharded_anti_entropy_step",
     "make_anti_entropy_step",
+    "ShardedDeviceMerkleState",
+    "resolve_shard_count",
 ]

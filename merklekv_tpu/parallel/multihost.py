@@ -85,6 +85,16 @@ def initialize(
                 "MKV_PROCESS_ID"
             )
         process_id = int(env)
+    # CPU backend: cross-process collectives need an explicit
+    # implementation — without gloo, XLA refuses the compiled step outright
+    # ("Multiprocess computations aren't implemented on the CPU backend",
+    # raised from the all_gather/psum executable). Harmless on TPU (the
+    # knob only affects CPU client creation); guarded because jax versions
+    # without (or past) the option reject/drop it.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

@@ -46,6 +46,10 @@ __all__ = [
     "sharded_divergence_2d",
     "sharded_anti_entropy_step",
     "make_anti_entropy_step",
+    "padded_level_specs",
+    "sharded_levels_program",
+    "sharded_scatter_program",
+    "sharded_restructure_program",
 ]
 
 
@@ -285,3 +289,169 @@ def sharded_anti_entropy_step(
     return make_anti_entropy_step(mesh, axis, use_pallas())(
         blocks, nblocks, digests, present
     )
+
+
+# --------------------------------------------------------------------------
+# Serving-tree SPMD programs (the ShardedDeviceMerkleState backend).
+#
+# The padded tree at capacity C = 2^d over a D-way mesh decomposes exactly
+# like the standalone root program above: per-shard leaf blocks of L = C/D
+# (a power of two) reduce to shard-local subtree levels with NO cross-shard
+# hash — every pair merge at a level of size >= D lives inside one shard's
+# contiguous block, so concatenating the shard blocks IS the global padded
+# level. Only the log2(D) top levels (sizes D/2 .. 1) combine across
+# shards: one all_gather of the D shard roots over ICI, then the tiny top
+# tree — computed redundantly on every shard (D-1 hashes), following the
+# parallel-first wide-top decomposition of "Note on Optimal Trees for
+# Parallel Hash Functions" (arxiv 1604.04206) / "Optimal Tree Hash Modes"
+# (arxiv 1607.00307). The returned tuple therefore has the SAME layout as
+# the single-device padded tree (level j is [C >> j, 8]), so every
+# promotion-chain query (root, TREELEVEL) runs unchanged and bit-identical.
+
+
+def padded_level_specs(capacity: int, d: int, axis: str) -> tuple:
+    """Per-level PartitionSpecs of the padded tree over a D-way mesh:
+    levels of size >= D stay keyspace-sharded; the top tree (size < D) is
+    replicated on every shard."""
+    specs = []
+    size = capacity
+    while size >= 1:
+        specs.append(P(axis, None) if size >= d else P(None, None))
+        size //= 2
+    return tuple(specs)
+
+
+def _local_level_count(capacity: int, d: int) -> int:
+    """Shard-local padded levels (sizes C .. D): log2(C/D) + 1."""
+    return (capacity // d).bit_length()
+
+
+def _reduce_padded(leaves: jax.Array) -> tuple:
+    """All padded-tree levels bottom-up (power-of-two input, no odd tail);
+    node hashing is backend-dispatched like merkle/incremental.py."""
+    from merklekv_tpu.ops.dispatch import hash_node_level
+
+    levels = [leaves]
+    cur = leaves
+    while cur.shape[0] > 1:
+        cur = hash_node_level(cur)
+        levels.append(cur)
+    return tuple(levels)
+
+
+@lru_cache(maxsize=None)
+def _levels_body(mesh: Mesh, axis: str, capacity: int):
+    """shard_map body: [C, 8] keyspace-sharded leaves -> every padded
+    level. Not jitted — composed both standalone (build) and inside the
+    restructure program."""
+    d = mesh.shape[axis]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=padded_level_specs(capacity, d, axis),
+        check_vma=False,
+    )
+    def go(block):  # [L, 8] local leaf slice
+        local = _reduce_padded(block)  # sizes L .. 1
+        roots = jax.lax.all_gather(local[-1], axis, axis=0, tiled=True)
+        top = _reduce_padded(roots)[1:]  # sizes D/2 .. 1 (empty when D=1)
+        return (*local, *top)
+
+    return go
+
+
+@lru_cache(maxsize=None)
+def sharded_levels_program(mesh: Mesh, axis: str, capacity: int, pallas: bool):
+    """Compiled sharded padded-tree build: per-shard subtrees reduce in
+    parallel, shard roots combine via all_gather + the wide top tree."""
+    del pallas  # cache key only; the dispatch is re-read at trace time
+    return jax.jit(_levels_body(mesh, axis, capacity))
+
+
+@lru_cache(maxsize=None)
+def sharded_scatter_program(
+    mesh: Mesh, axis: str, capacity: int, kb: int, nblk: int, pallas: bool
+):
+    """Fused per-shard-routed incremental update: ONE SPMD program hashes
+    each shard's routed sub-batch, scatters it into the shard-local leaf
+    slice, re-reduces only the touched parent paths, and rebuilds the tiny
+    top tree from the all_gathered shard roots.
+
+    Inputs are ROUTED host-side ([D, kb, ...] arrays sharded on dim 0, so
+    each device receives only its own sub-batch): ``idx`` holds SHARD-LOCAL
+    leaf positions with L (one past the slice) as the padding sentinel —
+    padded rows scatter into a scratch row appended per level and dropped
+    from the output, so a shard with fewer (or zero) updates dispatches the
+    same program with no-op rows instead of forcing a ragged shape.
+    """
+    del pallas
+    d = mesh.shape[axis]
+    l = capacity // d
+    specs = padded_level_specs(capacity, d, axis)
+    n_local = _local_level_count(capacity, d)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            *specs[:n_local],
+            P(axis, None),              # idx      [D, kb]
+            P(axis, None, None, None),  # blocks   [D, kb, nblk, 16]
+            P(axis, None),              # nblocks  [D, kb]
+        ),
+        out_specs=specs,
+        check_vma=False,
+    )
+    def go(*args):
+        levels, (idx, blocks, nblocks) = args[:n_local], args[n_local:]
+        from merklekv_tpu.ops.dispatch import hash_blocks as _hash
+        from merklekv_tpu.ops.dispatch import hash_node_pairs as _pairs
+
+        new = _hash(blocks[0], nblocks[0])  # [kb, 8]
+        tgt = idx[0]  # [kb] local positions; pads already == scratch (L)
+        scratch = jnp.zeros((1, 8), jnp.uint32)
+        child = jnp.concatenate([levels[0], scratch]).at[tgt].set(new)
+        out = [child[:-1]]
+        cur = tgt
+        for j in range(1, n_local):
+            size = levels[j].shape[0]  # l >> j
+            # Parent path; pads carry through to each level's scratch slot.
+            cur = jnp.minimum(cur // 2, size)
+            # Children read from the UPDATED child level; a pad's children
+            # (2*size, 2*size+1) hit the scratch row / clamp out of range —
+            # garbage hashed into scratch, dropped below.
+            parents = _pairs(child[2 * cur], child[2 * cur + 1])
+            child = jnp.concatenate([levels[j], scratch]).at[cur].set(parents)
+            out.append(child[:-1])
+        roots = jax.lax.all_gather(out[-1], axis, axis=0, tiled=True)
+        top = _reduce_padded(roots)[1:]
+        return (*out, *top)
+
+    return jax.jit(go)
+
+
+@lru_cache(maxsize=None)
+def sharded_restructure_program(
+    mesh: Mesh, axis: str, c_old: int, c_new: int, kb: int, pallas: bool
+):
+    """Compiled shape change over the mesh: cross-shard gather of surviving
+    leaf digests into their shifted slots (GSPMD inserts the collective
+    permute), scatter of the kb fresh digests, then the per-shard subtree
+    reduction + all_gather top tree — survivors never rehash, exactly like
+    the single-device restructure."""
+    del pallas
+    leaf_spec = NamedSharding(mesh, P(axis, None))
+    body = _levels_body(mesh, axis, c_new)
+
+    @jax.jit
+    def go(old_leaves, gather_idx, fresh_pos, fresh):
+        safe = jnp.clip(gather_idx, 0, max(c_old - 1, 0))
+        base = jnp.where((gather_idx >= 0)[:, None], old_leaves[safe], 0)
+        if kb:
+            base = base.at[fresh_pos].set(fresh)
+        base = jax.lax.with_sharding_constraint(base, leaf_spec)
+        return body(base)
+
+    return go

@@ -71,6 +71,14 @@ def test_direction_rules():
         "tree_freshness_write_p99_us",
         "us (SET p99 under concurrent TREELEVEL load, pump path)",
     )
+    # Sharded-plane scenario gates as throughput: mesh rebuild+diff keys/s
+    # must not DROP — a change that serializes the per-shard subtree
+    # reduction (or breaks the all_gather top tree back to host hashing)
+    # is exactly what this direction pins.
+    assert not bench_gate.lower_is_better(
+        "sharded_rebuild_diff_keys_per_s",
+        "keys/s (rebuild + 8-replica diff over the key mesh)",
+    )
 
 
 def test_compare_flags_only_real_regressions():

@@ -612,6 +612,28 @@ def test_blackbox_merges_ordered_timeline_with_trace_links(tmp_path):
     assert {"degradation", "sync_failure", "lag_spike"} <= kinds
 
 
+def test_blackbox_flags_device_shortfall_as_environment(tmp_path):
+    """A multichip probe spill whose device-enumerate phase shows fewer
+    devices than requested (MULTICHIP_r01's failure mode) surfaces as an
+    ENVIRONMENT anomaly — triage reads driver weather, not a regression —
+    while a full-complement enumerate stays silent."""
+    d = tmp_path / "probe"
+    r = FlightRecorder()
+    r.record("multichip_phase", phase="device-count", want=8, have=1)
+    write_spill(str(d / "flight.bin"), r.last(0), [], node="probe")
+    report = load_docs([str(d)])
+    envs = [a for a in report.anomalies if a.kind == "environment"]
+    assert len(envs) == 1 and "have 1, want 8" in envs[0].detail
+
+    d2 = tmp_path / "probe-ok"
+    r2 = FlightRecorder()
+    r2.record("multichip_phase", phase="device-count", want=8, have=8)
+    write_spill(str(d2 / "flight.bin"), r2.last(0), [], node="probe")
+    assert not [
+        a for a in load_docs([str(d2)]).anomalies if a.kind == "environment"
+    ]
+
+
 def test_blackbox_cli_json_and_rc(tmp_path, capsys):
     d1, d2 = _spill_pair(tmp_path)
     rc = blackbox_main([d1, d2, "--json"])

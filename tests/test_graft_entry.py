@@ -58,6 +58,42 @@ def test_multichip_phase_breadcrumbs(tmp_path, monkeypatch, capsys):
     assert all("seconds" in p for p in doc["phases"])
 
 
+def test_error_kind_classification():
+    """MULTICHIP_r01's death ("need 8 devices, have 1") must classify as an
+    ENVIRONMENT failure — the driver's weather, not a code regression — so
+    bench triage and blackbox stop paging on device-complement shortfalls.
+    Assertion failures from the probe's own math stay "code"."""
+    env = __graft_entry__._classify_error
+    assert env("RuntimeError: need 8 devices, have 1") == "environment"
+    assert env("mesh needs 8 devices, have 1") == "environment"
+    assert env("Unable to initialize backend 'tpu'") == "environment"
+    assert env("DEADLINE_EXCEEDED: rpc timed out") == "environment"
+    assert env("watchdog: 240s deadline expired in phase 'mesh-init'") == (
+        "environment"
+    )
+    assert env("AssertionError: sharded root != single-device root") == "code"
+    assert env("TypeError: unsupported operand") == "code"
+
+
+def test_device_count_flight_event_precedes_mesh_init(tmp_path, monkeypatch):
+    """The probe records the delivered device complement (want/have) as a
+    phase breadcrumb BEFORE mesh init — and the enumerate breadcrumb lands
+    BEFORE the first backend touch, so a hang inside jax.devices() is
+    attributed to enumeration, not its predecessor."""
+    import json
+
+    phase_file = tmp_path / "phases.json"
+    monkeypatch.setenv("MKV_PHASE_FILE", str(phase_file))
+    __graft_entry__.dryrun_multichip(8)
+    doc = json.loads(phase_file.read_text())
+    by_name = {p["phase"]: p for p in doc["phases"]}
+    names = [p["phase"] for p in doc["phases"]]
+    assert names.index("device-enumerate") < names.index("device-count")
+    assert names.index("device-count") < names.index("mesh-init")
+    assert by_name["device-count"]["want"] == 8
+    assert by_name["device-count"]["have"] >= 8
+
+
 def test_watchdog_exits_with_sidecar_and_record(tmp_path):
     """A hung probe must die by the INTERNAL watchdog, not the driver's
     rc=124 kill: exit 3, a partial JSON record on stdout naming the stuck
@@ -90,6 +126,8 @@ def test_watchdog_exits_with_sidecar_and_record(tmp_path):
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["ok"] is False
     assert "mesh-init-sim" in rec["error"]
+    # A watchdog timeout is tunnel/backend weather, not a regression.
+    assert rec["error_kind"] == "environment"
     assert any(p["phase"] == "mesh-init-sim" for p in rec["phases"])
     doc = json.loads(phase_file.read_text())
     names = [p["phase"] for p in doc["phases"]]

@@ -76,15 +76,13 @@ def _free_port() -> int:
 
 
 @pytest.mark.integration
-@pytest.mark.xfail(
-    reason="CPU backend cannot run multiprocess SPMD: jax raises "
-    "'Multiprocess computations aren't implemented on the CPU backend' "
-    "inside sharded_anti_entropy_step. The 2-process DCN path needs real "
-    "multi-host devices (MERKLEKV_TEST_BACKEND=tpu); non-strict so a "
-    "jax that grows CPU cross-process support starts counting again.",
-    strict=False,
-)
 def test_two_process_cluster_agrees_with_golden(tmp_path):
+    # Formerly xfail("Multiprocess computations aren't implemented on the
+    # CPU backend") — that XlaRuntimeError came from executing the
+    # all_gather/psum collectives with no cross-process CPU collectives
+    # implementation configured. multihost.initialize now selects gloo
+    # (jax_cpu_collectives_implementation) before jax.distributed
+    # initializes, and the 2-process SPMD step runs for real.
     import os
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,265 @@
+"""Sharded device Merkle plane (ISSUE 12): serving-tree parity and wiring.
+
+ShardedDeviceMerkleState must answer root/TREELEVEL bit-identically to the
+CPU golden (and hence single-device) tree at every shard count, through
+per-shard-routed incremental scatters and cross-shard restructures; the
+mirror/node plumbing must select it via [device] sharding and keep the
+PR 11 pump contract (no-flush-on-query) intact. Runs on the virtual
+8-device CPU mesh (conftest)."""
+
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from merklekv_tpu.merkle.cpu import build_levels
+from merklekv_tpu.merkle.encoding import leaf_hash
+from merklekv_tpu.parallel.sharded_state import (
+    ShardedDeviceMerkleState,
+    resolve_shard_count,
+)
+
+
+def _golden_levels(items):
+    return build_levels([leaf_hash(k, v) for k, v in sorted(items.items())])
+
+
+def _golden_root(items):
+    return _golden_levels(items)[-1][0].hex() if items else "0" * 64
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _prewarm_jax():
+    """Pay the first shard_map compile once, not inside a timed test."""
+    st = ShardedDeviceMerkleState.from_items([(b"warm", b"up")], shards=2)
+    st.apply([(b"warm", b"again")])
+    _ = st.root_hex()
+
+
+@pytest.mark.parametrize("shards", [1, 2, 8])
+def test_build_scatter_restructure_parity(shards):
+    items = {b"sp%05d" % i: b"v%d" % i for i in range(133)}
+    st = ShardedDeviceMerkleState.from_items(items.items(), shards=shards)
+    assert st.shard_count == shards
+    assert st.root_hex() == _golden_root(items)
+
+    # Value-only batch STRADDLING shard boundaries: hit the last leaf of
+    # one shard and the first of the next, for every boundary.
+    skeys = sorted(items)
+    l = st._capacity // shards
+    batch = {}
+    for b in range(1, shards):
+        for p in (b * l - 1, b * l):
+            if p < len(skeys):
+                batch[skeys[p]] = b"x%d" % p
+    batch[skeys[0]] = b"first"
+    batch[skeys[-1]] = b"last"
+    items.update(batch)
+    st.apply(list(batch.items()))
+    assert st.root_hex() == _golden_root(items)
+    assert st.incremental_batches >= 1
+
+    # Structural change crossing shard boundaries (capacity growth).
+    changes = []
+    for i in range(500, 560):
+        items[b"zz%05d" % i] = b"n%d" % i
+        changes.append((b"zz%05d" % i, b"n%d" % i))
+    del items[b"sp00003"]
+    changes.append((b"sp00003", None))
+    st.apply(changes)
+    assert st.root_hex() == _golden_root(items)
+    assert st.structural_batches >= 1
+
+
+@pytest.mark.parametrize("shards", [2, 8])
+def test_level_nodes_parity_every_level(shards):
+    items = {b"lv%04d" % i: b"val%d" % i for i in range(97)}
+    st = ShardedDeviceMerkleState.from_items(items.items(), shards=shards)
+    glv = _golden_levels(items)
+    for lvl in range(len(glv)):
+        rows, n = st.level_nodes(lvl, 0, len(glv[lvl]))
+        assert n == len(items)
+        assert [d for _, d in rows] == glv[lvl]
+    # Interior slices too (the walk fetches bounded runs, not whole levels).
+    rows, _ = st.level_nodes(1, 3, 11)
+    assert [d for _, d in rows] == glv[1][3:11]
+
+
+def test_drain_to_empty_and_refill():
+    items = {b"e1": b"a", b"e2": b"b", b"e3": b"c"}
+    st = ShardedDeviceMerkleState.from_items(items.items(), shards=8)
+    assert st._capacity >= 8  # padded up to the mesh axis
+    st.apply([(k, None) for k in items])
+    assert st.root_hex() == "0" * 64
+    st.apply([(b"back", b"again")])
+    assert st.root_hex() == _golden_root({b"back": b"again"})
+
+
+def test_rebuild_metrics_and_gauge_surface():
+    from merklekv_tpu.utils.tracing import get_metrics
+
+    before = get_metrics().snapshot()["counters"].get("device.shard_batches", 0)
+    st = ShardedDeviceMerkleState.from_items(
+        ((b"m%03d" % i, b"v") for i in range(40)), shards=2
+    )
+    after = get_metrics().snapshot()["counters"].get("device.shard_batches", 0)
+    assert after > before
+    assert st.last_shard_rebuild_us >= 0
+
+
+def test_resolve_shard_count():
+    assert resolve_shard_count("off", 8) == 0
+    assert resolve_shard_count("auto", 8) == 8
+    assert resolve_shard_count("auto", 6) == 4  # largest pow2 subset
+    assert resolve_shard_count("auto", 1) == 0  # single device: plain state
+    assert resolve_shard_count("2", 8) == 2
+    assert resolve_shard_count(4, 8) == 4
+    assert resolve_shard_count("1", 8) == 1  # explicit 1 = SPMD over 1 dev
+    assert resolve_shard_count("16", 8) == 8  # clamped to the complement
+    with pytest.raises(ValueError, match="power-of-two"):
+        resolve_shard_count("3", 8)
+
+
+def test_shard_count_validation():
+    with pytest.raises(ValueError, match="power of two"):
+        ShardedDeviceMerkleState(shards=3)
+    with pytest.raises(ValueError, match="exceeds local device count"):
+        ShardedDeviceMerkleState(shards=16)
+
+
+def test_config_sharding_values(tmp_path):
+    from merklekv_tpu.config import Config
+
+    assert Config().device.sharding == "off"
+    p = tmp_path / "c.toml"
+    p.write_text("[device]\nsharding = \"auto\"\n")
+    assert Config.load(str(p)).device.sharding == "auto"
+    p.write_text("[device]\nsharding = 4\n")
+    assert Config.load(str(p)).device.sharding == "4"
+    # Deprecated alias promotes to auto.
+    p.write_text("[device]\nsharded_mirror = true\n")
+    assert Config.load(str(p)).device.sharding == "auto"
+    p.write_text("[device]\nsharding = 3\n")
+    with pytest.raises(ValueError, match="power-of-two"):
+        Config.load(str(p))
+
+
+def test_divergence_engine_boundary_parity():
+    """The N-replica diff routed through the sharded SPMD program must be
+    bit-identical to the host twin, including a key axis that does not
+    divide the mesh (padded with absent columns)."""
+    from merklekv_tpu.merkle.diff import (
+        divergence_masks_engine,
+        divergence_masks_np,
+    )
+
+    rng = np.random.RandomState(7)
+    for n in (64, 77):  # 77: pad path (not divisible by the 8-way mesh)
+        dig = np.tile(
+            rng.randint(0, 2**32, size=(1, n, 8), dtype=np.uint64).astype(
+                np.uint32
+            ),
+            (5, 1, 1),
+        )
+        pres = np.ones((5, n), bool)
+        dig[2, rng.randint(0, n, size=4)] ^= 9
+        pres[3, rng.randint(0, n, size=3)] = False
+        golden = divergence_masks_np(dig, pres)
+        routed = np.asarray(divergence_masks_engine(dig, pres, min_keys=0))
+        assert np.array_equal(routed, golden)
+    # Above-threshold default path stays callable (single-device route for
+    # small n when min_keys is left at the default).
+    small = np.asarray(
+        divergence_masks_engine(dig[:, :16], pres[:, :16])
+    )
+    assert np.array_equal(small, divergence_masks_np(dig[:, :16], pres[:, :16]))
+
+
+def test_mirror_sharded_backend_and_pump_contract():
+    """DeviceTreeMirror with [device] sharding=8 serves the pump-published
+    snapshot from the sharded state — bit-identical to the engine root —
+    and the no-flush-on-query invariant holds (published reads never drain
+    staged work)."""
+    from merklekv_tpu.cluster.mirror import DeviceTreeMirror
+    from merklekv_tpu.native_bindings import NativeEngine
+
+    engine = NativeEngine("mem")
+    try:
+        for i in range(64):
+            engine.set(b"mk%03d" % i, b"v%d" % i)
+        mirror = DeviceTreeMirror(engine, sharding="8")
+        try:
+            mirror.start_warming()
+            deadline = time.time() + 60
+            while time.time() < deadline and not mirror.ready():
+                time.sleep(0.02)
+            assert mirror.ready(), "sharded mirror never warmed"
+            assert mirror.shard_count() == 8
+            assert mirror.published_root_hex() == engine.merkle_root().hex()
+            # Stage a write; the published snapshot must NOT move until the
+            # pump publishes (no-flush-on-query).
+            engine.set(b"mk000", b"updated")
+            from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind
+
+            ev = ChangeEvent(
+                op=OpKind.SET, key="mk000", val=b"updated",
+                ts=time.time_ns(), src="test",
+            )
+            gen_before = mirror._published_gen
+            mirror.on_events([ev], watermark=engine.version())
+            _ = mirror.published_root_hex()  # read-only serve
+            mirror.publish_now()
+            assert mirror.published_root_hex() == engine.merkle_root().hex()
+            assert mirror._published_gen > gen_before
+            assert mirror.shard_rebuild_us() >= 0
+        finally:
+            mirror.close()
+    finally:
+        engine.close()
+
+
+def test_cluster_node_metrics_lines_with_sharding():
+    """End-to-end [device] sharding=2 node: HASH serves the sharded tree
+    and METRICS carries the device.shards line."""
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.cluster.transport import TcpBroker
+    from merklekv_tpu.config import Config
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    broker = TcpBroker()
+    engine = NativeEngine("mem")
+    server = NativeServer(engine, "127.0.0.1", 0)
+    server.start()
+    cfg = Config()
+    cfg.replication.enabled = True
+    cfg.replication.mqtt_broker = broker.host
+    cfg.replication.mqtt_port = broker.port
+    cfg.replication.topic_prefix = f"shardp-{uuid.uuid4().hex[:8]}"
+    cfg.replication.client_id = "sp1"
+    cfg.device.sharding = "2"
+    node = ClusterNode(cfg, engine, server)
+    node.start()
+    client = MerkleKVClient("127.0.0.1", server.port, timeout=30.0).connect()
+    try:
+        for i in range(40):
+            client.set(f"spk{i:03d}", f"val{i}")
+        native_root = engine.merkle_root().hex()
+        client.hash()  # trigger warming
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if node._mirror is not None and node._mirror.ready():
+                break
+            time.sleep(0.02)
+        assert node._mirror.ready(), "mirror never warmed"
+        assert node._mirror.shard_count() == 2
+        assert node.device_root_hex(force=True) == native_root
+        metrics = client.metrics()
+        assert metrics.get("device.shards") == "2"
+    finally:
+        client.close()
+        node.stop()
+        server.close()
+        engine.close()
+        broker.close()
