@@ -47,18 +47,31 @@ conservative watermark.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Optional
 
 from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind
+from merklekv_tpu.device.guard import DeviceDispatchError, configure as _configure_guard
+from merklekv_tpu.device.ladder import (
+    DeviceBackendLadder,
+    build_state_for_rung,
+)
 from merklekv_tpu.native_bindings import NativeEngine
+from merklekv_tpu.obs.metrics import get_metrics
+from merklekv_tpu.utils.errorkind import classify_exception
 
 __all__ = ["DeviceTreeMirror"]
 
 # One tree_staleness flight flag per this many seconds (same one-flag-per-
 # window discipline as the blackbox slow-command bursts).
 _STALENESS_FLAG_WINDOW_S = 10.0
+
+# One device_fallback heartbeat per this many seconds while a previously
+# ready mirror serves off the native fallback (post-invalidate) — a node on
+# the fallback rung must be visible in the flight timeline, not silent.
+_FALLBACK_FLAG_WINDOW_S = 10.0
 
 
 class DeviceTreeMirror:
@@ -69,6 +82,11 @@ class DeviceTreeMirror:
         max_staleness_ms: float = 200.0,
         max_staleness_versions: int = 0,
         sharding: str = "off",
+        dispatch_deadline_ms: Optional[float] = None,
+        scrub_interval_s: float = 30.0,
+        scrub_keys: int = 256,
+        degrade_after: int = 2,
+        ladder: Optional[DeviceBackendLadder] = None,
     ) -> None:
         self._engine = engine
         # Serving-tree backend selection ([device] sharding = auto|off|N):
@@ -105,7 +123,13 @@ class DeviceTreeMirror:
         self._staged_version = 0
         self._published_version = 0
         self._published_gen = 0  # bumps on every publish; keys the root cache
-        self._published_root: Optional[str] = None  # lazy per generation
+        # The published (root, version) pair — the ONLY root cache: one
+        # immutable tuple assigned under _mu, read WITHOUT it by the
+        # root-serving fast path — a HASH never waits behind a pump drain
+        # holding the mirror lock across a device dispatch. Root is None
+        # while warming / after invalidate / when a publish had no eager
+        # root (the locked lazy path refills it).
+        self._pub_snapshot: tuple[Optional[str], int] = (None, 0)
         self._staged_since_m: Optional[float] = None  # oldest unpublished stage
         self._last_publish_m = 0.0
         self._staleness_flagged_m = -1e18
@@ -115,6 +139,32 @@ class DeviceTreeMirror:
         # Test hook: callable raised/invoked inside the pump's drain (chaos
         # tests kill the pump mid-drain through it). None in production.
         self._pump_inject = None
+        # Fault containment ([device]): every dispatch under the warm
+        # build, the pump, and the query paths runs deadline-guarded —
+        # process-wide guard, last EXPLICIT configuration wins
+        # (documented). A mirror built without a deadline must not clobber
+        # a node's configured value with the guard default.
+        if dispatch_deadline_ms is not None:
+            _configure_guard(deadline_ms=dispatch_deadline_ms)
+        # The degradation ladder. Resolved lazily (the rung list needs the
+        # local device complement, i.e. a jax import) unless a test
+        # injected one.
+        self._ladder = ladder
+        self._degrade_after = max(1, int(degrade_after))
+        # Integrity scrub: low-rate background cross-check of served
+        # device leaf digests against the CPU golden hash over a sampled
+        # range (0 = off).
+        self._scrub_interval_s = float(scrub_interval_s)
+        self._scrub_keys = max(1, int(scrub_keys))
+        self._scrub_rng = random.Random()
+        self._last_scrub_m = time.monotonic()
+        # Fallback-serving heartbeat state (see _check_fallback_heartbeat).
+        self._was_ready = False
+        self._fallback_flagged_m = -1e18
+        self._replacing = False  # a replace-warm (heal re-warm) in flight
+        self._probing = False  # a heal-probe pass in flight (own thread)
+        self._scrubbing = False  # a scrub pass in flight (own thread)
+        self._scrub_thread: Optional[threading.Thread] = None
 
     # -- warm-up -------------------------------------------------------------
     def ready(self) -> bool:
@@ -122,11 +172,15 @@ class DeviceTreeMirror:
 
     def invalidate(self) -> None:
         """Throw the device state away (e.g. after a failed batch apply);
-        the next HASH request answers natively and triggers a re-warm."""
+        the next HASH request answers natively and triggers a re-warm.
+        While the state is gone, a previously ready mirror emits one
+        ``device_fallback`` heartbeat per 10 s window (the flight
+        sampler's gauge poll drives it) so fallback serving is visible in
+        the timeline, not silent."""
         with self._mu:
             self._state = None
             self._pending = None
-            self._published_root = None
+            self._pub_snapshot = (None, 0)
             self._staged_since_m = None
         self._warming.clear()
 
@@ -143,6 +197,9 @@ class DeviceTreeMirror:
         t = self._warm_thread
         if t is not None and t.is_alive():
             t.join(timeout=30)
+        s = self._scrub_thread
+        if s is not None and s.is_alive():
+            s.join(timeout=30)
 
     def start_warming(self) -> None:
         """Build the device state off the serving path.
@@ -153,56 +210,129 @@ class DeviceTreeMirror:
         mirror lock — holding it would stall the replicator drain loop and
         inbound LWW applies for the whole compile. Writes landing during
         the build are recorded (keys only) and replayed from the engine's
-        current values at swap-in; a truncate mid-build restarts it."""
+        current values at swap-in; a truncate mid-build restarts it. The
+        build itself rides the degradation ladder: a rung whose dispatch
+        fails steps down, so warming always completes at SOME rung (the
+        CPU golden tree is infallible)."""
         self._ensure_pump()
         if self._warming.is_set():
             return
         self._warming.set()
+        self._spawn_warm(replace=False)
 
+    def _start_replace_warm(self) -> None:
+        """Re-warm at the ladder's (newly climbed) rung while the CURRENT
+        state keeps serving — the heal path's zero-downtime rebuild. The
+        old snapshot answers queries until the new state swaps in under
+        one lock hold; version stamps stay monotone (publish always
+        max()es)."""
+        with self._mu:
+            if self._closed or self._replacing:
+                return
+            self._replacing = True
+        self._spawn_warm(replace=True)
+
+    def _spawn_warm(self, replace: bool) -> None:
         def warm() -> None:
             try:
-                for _attempt in range(3):
+                self._warm_body(replace)
+            finally:
+                if replace:
                     with self._mu:
-                        if self._state is not None or self._closed:
-                            return
-                        self._pending = set()
-                        self._pending_truncate = False
-                        # Watermark BEFORE the snapshot: every mutation at
-                        # or below it is in the snapshot by construction;
-                        # later ones either land in _pending or stage their
-                        # own event with a higher watermark.
-                        v0 = self._engine.version()
-                        items = self._engine.snapshot()
-                    st = self._build_state(items)
-                    # Pay the build + kernel-compile cost HERE so the first
-                    # post-warm HASH answers immediately.
-                    st.root_hex()
-                    with self._mu:
-                        if self._closed:
-                            return
-                        if self._pending_truncate:
-                            self._pending = None
-                            continue  # keyspace vanished mid-build; redo
-                        pend, self._pending = self._pending, None
-                        if pend:
-                            st.apply(
-                                [(k, self._engine.get(k)) for k in pend]
-                            )
-                            st.flush_pending()
-                        self._state = st
-                        self._staged_version = max(
-                            self._staged_version, v0
-                        )
-                        self._publish_locked()
-                        return
-            except Exception:
-                pass
-            self._warming.clear()  # allow a retry
+                        self._replacing = False
+            # The ladder may have climbed again while this build ran —
+            # the pump's _maybe_heal invariant check re-warms at the
+            # final rung next wake; poke it so that happens promptly.
+            if replace and not self._closed:
+                self._pump_wake.set()
 
         self._warm_thread = threading.Thread(
             target=warm, daemon=True, name="mkv-mirror-warm"
         )
         self._warm_thread.start()
+
+    def _warm_body(self, replace: bool) -> None:
+        mine: Optional[set] = None
+        try:
+            for _attempt in range(3):
+                with self._mu:
+                    if self._closed:
+                        return
+                    if self._state is not None and not replace:
+                        return
+                    # Ownership-tagged pending set: invalidate() (sets it
+                    # None) or a concurrently spawned warm (replaces it)
+                    # both ORPHAN this attempt's recording — the swap-in
+                    # below checks identity and restarts from a fresh
+                    # snapshot rather than install a state whose
+                    # mid-build writes were recorded into someone else's
+                    # set (that stamped-fresh-but-missing-writes state
+                    # would serve a silently wrong root).
+                    mine = set()
+                    self._pending = mine
+                    self._pending_truncate = False
+                    # Watermark BEFORE the snapshot: every mutation at
+                    # or below it is in the snapshot by construction;
+                    # later ones either land in _pending or stage their
+                    # own event with a higher watermark.
+                    v0 = self._engine.version()
+                    items = self._engine.snapshot()
+                st = self._build_state(items)
+                # Pay the build + kernel-compile cost HERE so the first
+                # post-warm HASH answers immediately.
+                st.root_hex()
+                with self._mu:
+                    if self._closed:
+                        return
+                    if self._pending is not mine:
+                        continue  # orphaned (invalidate/new warm); redo
+                    if self._pending_truncate:
+                        self._pending = None
+                        continue  # keyspace vanished mid-build; redo
+                    pend, self._pending = self._pending, None
+                    # The replay below fixes VALUES for keys whose events
+                    # already drained into pend, but it cannot raise the
+                    # coverage watermark past v0: local writes reach
+                    # _pending only through the async drain, so a write
+                    # between v0 and the current engine version may be in
+                    # neither the snapshot nor pend. Fencing to the
+                    # current version would OVERCLAIM — staleness() reads
+                    # 0 for a tree missing that write, and the scrub's
+                    # quiescence check would then call the miss silent
+                    # corruption. v0 understates at worst (allowed); the
+                    # write's own event bumps the watermark when it
+                    # drains.
+                    if pend:
+                        st.apply(
+                            [(k, self._engine.get(k)) for k in pend]
+                        )
+                        st.flush_pending()
+                    # Eager root BEFORE the install (same contract as
+                    # publish_now): a failing walk unwinds into the warm
+                    # retry path with nothing half-published.
+                    root = st.root_hex(flush=False)
+                    self._state = st
+                    self._was_ready = True
+                    self._staged_version = max(
+                        self._staged_version, v0
+                    )
+                    self._publish_locked()
+                    self._pub_snapshot = (root, self._published_version)
+                    self._warming.set()
+                    return
+        except Exception:
+            pass
+        finally:
+            # Never leak a live recording set from a dead attempt: fresh
+            # staging would keep feeding keys no warm will ever consume.
+            with self._mu:
+                if mine is not None and self._pending is mine:
+                    self._pending = None
+        if not replace:
+            self._warming.clear()  # allow a retry
+        # A failed REPLACE warm leaves the old state serving; _warming
+        # stays set (its meaning — "a built state is in place") and the
+        # pump's heal/invariant pass schedules another attempt.
 
     # -- event feeds (staging: never device work beyond PENDING_LIMIT) -------
     def on_events(
@@ -244,6 +374,13 @@ class DeviceTreeMirror:
                 self._state.apply(
                     [(k, self._engine.get(k)) for k in touched]
                 )
+            if self._pending is not None:
+                # A replace re-warm (ladder heal) is building a successor
+                # state off the engine snapshot: record these keys for
+                # replay at its swap-in, like the initial warm does.
+                if truncated:
+                    self._note_pending([None])
+                self._note_pending(iter(touched))
             self._note_staged(watermark)
             if truncated:
                 # The served tree content changed in place (reset): flush
@@ -273,6 +410,9 @@ class DeviceTreeMirror:
                 self._note_pending(k for k, _ in pairs)
                 return
             self._state.apply(pairs)
+            if self._pending is not None:
+                # Replace re-warm in flight: replay these at swap-in too.
+                self._note_pending(k for k, _ in pairs)
             self._note_staged(None)
         self._ensure_pump()  # a dead pump is respawned by fresh staging
         self._pump_wake.set()
@@ -355,15 +495,17 @@ class DeviceTreeMirror:
                 try:
                     self.publish_now()
                     get_metrics().inc("device.pump_batches")
-                except Exception:
-                    # A wedged device drain must not serve a divergent tree
-                    # forever: flag the timeline, then throw the state away
-                    # (queries fall back to the native path and trigger a
-                    # re-warm, which also respawns this pump if the failure
-                    # killed it). The flag rides the tree_staleness event —
-                    # after invalidate() the breach check goes silent
-                    # (state None), so this is the one chance to record
-                    # the drain death.
+                    if self._ladder is not None:
+                        self._ladder.note_success()
+                except Exception as e:
+                    # A failed drain must not serve a divergent tree (the
+                    # staged batch was RESTORED by the state's flush, so
+                    # the published snapshot stays consistent — just
+                    # stale): flag the timeline, count the failure against
+                    # the current ladder rung, and once the rung is deemed
+                    # sick, step down + rebuild there. Before the step, the
+                    # next pump wake simply retries — a one-off backend
+                    # blip costs one coalesce window, not the whole tree.
                     get_metrics().inc("device.pump_errors")
                     try:
                         since = self._staged_since_m
@@ -385,8 +527,11 @@ class DeviceTreeMirror:
                         )
                     except Exception:
                         pass
-                    self.invalidate()
+                    self._on_drain_failure(e)
+            self._maybe_heal()
+            self._maybe_scrub()
             self._check_staleness_breach()
+            self._check_fallback_heartbeat()
 
     def publish_now(self) -> None:
         """Synchronous drain + publish — the ``force=true`` escape hatch
@@ -404,7 +549,26 @@ class DeviceTreeMirror:
             )
             self._state.flush_pending()
             if had_work or self._published_gen == 0:
+                # Eager root BEFORE the generation bump: pay the
+                # promotion-chain walk HERE (the pump already owns this
+                # cycle's device budget) so query threads serve the
+                # cached snapshot with ZERO device work. A FLUSH that
+                # dies restores its staged batch, so the previous publish
+                # stays fully intact (ver_lag stays > 0, the pump
+                # retries, the failure feeds the ladder). A ROOT WALK
+                # that dies after a successful flush is different: the
+                # tree content has already advanced past the published
+                # stamp, so keeping the old snapshot would hand a walker
+                # level digests that don't hash to the served root —
+                # invalidate (native fallback answers, re-warm restores)
+                # and let the raised error feed the ladder as usual.
+                try:
+                    root = self._state.root_hex(flush=False)
+                except BaseException:
+                    self.invalidate()
+                    raise
                 self._publish_locked()
+                self._pub_snapshot = (root, self._published_version)
 
     def _publish_locked(self) -> None:
         """Stamp the built tree as the served snapshot (lock held; the
@@ -414,7 +578,8 @@ class DeviceTreeMirror:
             self._published_version, self._staged_version
         )
         self._published_gen += 1
-        self._published_root = None  # recomputed lazily, cached per gen
+        # Root recomputed lazily, cached per generation in _pub_snapshot.
+        self._pub_snapshot = (None, self._published_version)
         self._staged_since_m = None
         self._last_publish_m = time.monotonic()
 
@@ -464,6 +629,274 @@ class DeviceTreeMirror:
             window_ms=int(self._window_s * 1000),
         )
 
+    # -- fault containment (ladder / heal / scrub / heartbeat) ---------------
+    def _on_drain_failure(self, e: BaseException) -> None:
+        """Pump-drain failure accounting, by classified kind:
+
+        - ``code`` (a bug in our own dispatch path, or an injected pump
+          death): the state is not trustworthy — invalidate NOW (native
+          fallback answers, a re-warm restores serving at the same rung).
+          The ladder does not step: the rung isn't sick, the code is.
+        - ``environment`` (backend blip, hang, tunnel death): below the
+          degrade threshold the published tree stays — consistent, just
+          stale; the flush restored its staged batch — and the next wake
+          retries. At the threshold the ladder steps down and the mirror
+          rebuilds at the lower rung (the build loop keeps stepping if
+          that rung is sick too, so the re-warm always lands somewhere)."""
+        kind = (
+            e.kind
+            if isinstance(e, DeviceDispatchError)
+            else classify_exception(e)
+        )
+        ladder = self._ladder
+        if kind == "code" or ladder is None:
+            # Invalidate only — the next query's warm-up rebuilds (the
+            # pre-ladder contract; tests observe the fallback window).
+            self.invalidate()
+            return
+        if ladder.note_failure(kind, "drain"):
+            self.invalidate()
+            # Rebuild proactively: anti-entropy serves off this tree, and
+            # a query-less node must not sit on the fallback rung when a
+            # lower rung can serve.
+            self.start_warming()
+
+    def _probe_rung(self, target: int) -> bool:
+        """One heal probe: build a tiny tree at ``target`` and check its
+        root against the CPU golden — a rung that dispatches but answers
+        WRONG is as sick as one that throws."""
+        probe_items = [(b"mkv:heal-probe", b"ok")]
+        try:
+            from merklekv_tpu.merkle.cpu_state import CpuMerkleState
+
+            golden = CpuMerkleState.from_items(probe_items).root_hex()
+            st = build_state_for_rung(target, probe_items)
+            return st.root_hex() == golden
+        except Exception:
+            return False
+
+    def _maybe_heal(self) -> None:
+        """Schedule the background re-warm probe: while degraded,
+        periodically (under ``retry.DEVICE_HEAL`` escalating backoff)
+        probe a higher rung — on the probe's OWN thread, never the
+        pump's: a hang-shaped fault at the probed rung costs the probe
+        thread a dispatch deadline, while the pump keeps draining the
+        healthy serving rung inside the staleness contract."""
+        ladder = self._ladder
+        if ladder is None or self._closed:
+            return
+        # Invariant repair: a probe climb can land while a replace build
+        # for a LOWER rung is still in flight — the swapped-in state then
+        # trails the ladder. Rebuild at the ladder's rung.
+        st = self._state
+        if (
+            st is not None
+            and not self._replacing
+            and int(getattr(st, "_n_shards", 1)) != ladder.current()
+        ):
+            self._start_replace_warm()
+            return
+        if not ladder.degraded() or not ladder.heal_due():
+            return
+        with self._mu:
+            if self._probing or self._closed:
+                return
+            self._probing = True
+        threading.Thread(
+            target=self._heal_probe_pass, daemon=True,
+            name="mkv-mirror-probe",
+        ).start()
+
+    def _heal_probe_pass(self) -> None:
+        """One probe pass (probe thread): consecutive successful probes
+        climb AS FAR AS THE PLANE ALLOWS (probes are tiny; full-size
+        rebuilds are not), then ONE replace re-warm rebuilds the serving
+        state at the final rung while the current state keeps serving."""
+        ladder = self._ladder
+        climbed = None
+        try:
+            while ladder.degraded() and not self._closed:
+                if climbed is None and not ladder.heal_due():
+                    return
+                ok = self._probe_rung(ladder.probe_target())
+                if ladder.note_probe(ok) is None:
+                    break  # failed probe: next attempt after its backoff
+                climbed = ladder.current()
+        finally:
+            with self._mu:
+                self._probing = False
+            if climbed is not None and not self._closed:
+                if self._state is None:
+                    self.start_warming()
+                else:
+                    self._start_replace_warm()
+
+    def _maybe_scrub(self) -> None:
+        """Schedule one scrub pass on its OWN thread, never the pump's —
+        the same invariant as the heal probe: the scrub's level gather is
+        a guarded dispatch, and a hang-shaped fault there would otherwise
+        park the pump for the full dispatch deadline while staged writes
+        blow through the staleness contract."""
+        if self._scrub_interval_s <= 0 or self._closed:
+            return
+        now = time.monotonic()
+        if now - self._last_scrub_m < self._scrub_interval_s:
+            return
+        with self._mu:
+            if self._scrubbing or self._closed:
+                return
+            self._scrubbing = True
+        self._last_scrub_m = now
+        self._scrub_thread = threading.Thread(
+            target=self._scrub_pass, daemon=True, name="mkv-mirror-scrub"
+        )
+        self._scrub_thread.start()
+
+    def _scrub_pass(self) -> None:
+        try:
+            self.scrub_once()
+        except Exception:
+            pass  # a failed scrub read is a dispatch problem, not a leak
+        finally:
+            with self._mu:
+                self._scrubbing = False
+
+    def scrub_once(self) -> Optional[bool]:
+        """Integrity scrub: cross-check a sampled leaf range of the SERVED
+        device tree against CPU golden leaf hashes recomputed from the
+        engine's current values. Runs only at a quiescent instant (nothing
+        staged, engine version == published version, re-checked after the
+        reads) so any mismatch proves SILENT DEVICE CORRUPTION — the tree
+        content cannot have legitimately moved — and triggers
+        invalidate + rebuild instead of serving a wrong root into
+        anti-entropy. Returns True (clean), False (mismatch, repair
+        kicked), or None (skipped: not quiescent / CPU rung / warming)."""
+        from merklekv_tpu.merkle.encoding import leaf_hash
+
+        with self._mu:
+            if self._closed or self._state is None:
+                return None
+            st = self._state
+            if getattr(st, "_n_shards", 1) == 0:
+                return None  # the CPU rung IS the golden tree
+            if st.pending_count() > 0:
+                return None
+            try:
+                v0 = self._engine.version()
+            except Exception:
+                return None
+            if v0 != self._published_version:
+                return None  # writes in flight; sample next time
+            n = st.leaf_count()
+            if n <= 0:
+                return None
+            k = min(self._scrub_keys, n)
+            lo = self._scrub_rng.randrange(0, n - k + 1)
+            gen0 = self._published_gen
+        # Device gather + engine reads OUTSIDE the mirror lock: the
+        # gather is a guarded dispatch — on a wedged backend it parks for
+        # the full dispatch deadline, and holding ``_mu`` across that
+        # would stall staging, applies, and every locked query path for
+        # the duration. The fences below (not ``_mu``) make a mismatch
+        # conclusive: keyspace movement bumps the engine version, tree
+        # movement (a pump flush or a replace swap-in mid-gather) bumps
+        # the publish generation or replaces the state object.
+        try:
+            out = st.level_nodes(0, lo, lo + k, flush=False)
+            if out is None:
+                return None
+            rows, _ = out
+            keys = list(st._keys[lo:lo + k])
+        except Exception:
+            return None  # raced a tree mutation; not conclusive
+        # The gather may have parked for the full dispatch deadline —
+        # close() could have run (and its join timed out) meanwhile, and
+        # the engine pointer is only valid until then.
+        with self._mu:
+            if self._closed:
+                return None
+        vals = [self._engine.get(key) for key in keys]
+        try:
+            if self._engine.version() != v0:
+                return None  # raced a write after all; not conclusive
+        except Exception:
+            return None
+        with self._mu:
+            if (
+                self._closed
+                or self._state is not st
+                or self._published_gen != gen0
+            ):
+                return None  # tree moved under the gather; not conclusive
+        get_metrics().inc("device.scrub_checks")
+        bad = None
+        for (idx, dig), key, val in zip(rows, keys, vals):
+            if val is None or leaf_hash(key, val) != dig:
+                bad = (idx, key)
+                break
+        if bad is None:
+            return True
+        # Mismatch: corruption. Count it against the rung (repeated
+        # corruption is a sick device, not cosmic rays) and rebuild from
+        # the engine — the engine is authoritative; the device tree is a
+        # cache.
+        get_metrics().inc("device.scrub_mismatches")
+        try:
+            from merklekv_tpu.obs.flightrec import record
+
+            record(
+                "device_corruption",
+                leaf_index=int(bad[0]),
+                rung=self.backend_level(),
+            )
+        except Exception:
+            pass
+        if self._ladder is not None:
+            self._ladder.note_failure("corruption", "scrub")
+        self.invalidate()
+        self.start_warming()
+        return False
+
+    def _check_fallback_heartbeat(self) -> None:
+        """One ``device_fallback`` flight event per flag window while a
+        previously ready mirror serves off the native fallback
+        (post-invalidate, pre-re-warm) — without it, invalidate() silenced
+        the staleness breach check (state None) and a node could sit on
+        the fallback rung indefinitely with nothing in the timeline.
+        Lock-free like the breach check, and invoked from both the pump
+        loop and the monitoring reads (``pump_lag_ms``), so it fires even
+        with the pump dead."""
+        if self._closed or self._state is not None or not self._was_ready:
+            return
+        now = time.monotonic()
+        if now - self._fallback_flagged_m < _FALLBACK_FLAG_WINDOW_S:
+            return
+        self._fallback_flagged_m = now
+        ladder = self._ladder
+        try:
+            from merklekv_tpu.obs.flightrec import record
+
+            record(
+                "device_fallback",
+                rung=ladder.current() if ladder is not None else -1,
+            )
+        except Exception:
+            pass
+
+    def backend_level(self) -> int:
+        """Serving-backend rung code — the ``device.backend_level`` gauge:
+        N>=2 sharded width, 1 single-device, 0 CPU golden tree, -1 native
+        fallback (warming / invalidated / closed). Lock-free: a monitoring
+        read must never park behind a device dispatch."""
+        st = self._state
+        if self._closed or st is None:
+            return -1
+        return int(getattr(st, "_n_shards", 1))
+
+    @property
+    def ladder(self) -> Optional[DeviceBackendLadder]:
+        return self._ladder
+
     # -- queries (published-snapshot serving) ---------------------------------
     def root_hex(self) -> str:
         """EXACT root: drains staged changes first (one publish), then
@@ -483,15 +916,23 @@ class DeviceTreeMirror:
 
     def published_root_hex(self) -> Optional[str]:
         """Root of the last-published snapshot (None while warming): the
-        bounded-staleness serving path. Cached per publish generation, so
-        a HASH storm costs one device root walk per pump cycle, not per
-        query."""
+        bounded-staleness serving path. Cached per publish generation —
+        and served LOCK-FREE off the ``_pub_snapshot`` tuple when the
+        eager publish filled it (the common case), so a HASH never waits
+        behind a pump drain holding ``_mu`` across a device dispatch.
+        The locked lazy path below only runs for publishes that skipped
+        the eager walk (PENDING_LIMIT / truncate inline publishes)."""
+        root, _ = self._pub_snapshot
+        if root is not None and self._state is not None and not self._closed:
+            return root
         with self._mu:
             if self._closed or self._state is None:
                 return None
-            if self._published_root is None:
-                self._published_root = self._state.root_hex(flush=False)
-            return self._published_root
+            root, _ = self._pub_snapshot
+            if root is None:
+                root = self._state.root_hex(flush=False)
+                self._pub_snapshot = (root, self._published_version)
+            return root
 
     def level_nodes(self, level: int, lo: int, hi: int):
         """TREELEVEL slice from the last-published device tree: reference-
@@ -520,9 +961,14 @@ class DeviceTreeMirror:
             return self._published_version if self._state is not None else 0
 
     def published_root_stamped(self) -> Optional[tuple[str, int]]:
-        """(root_hex, published_version) read under ONE lock hold, so the
-        stamp can never claim a different generation than the root it rides
-        with. None while warming."""
+        """(root_hex, published_version) read atomically — the stamp can
+        never claim a different generation than the root it rides with.
+        Lock-free off ``_pub_snapshot`` (one immutable tuple) when the
+        eager root is in place; the locked path covers lazy fills. None
+        while warming."""
+        snap = self._pub_snapshot
+        if snap[0] is not None and self._state is not None and not self._closed:
+            return snap
         with self._mu:
             root = self.published_root_hex()
             if root is None:
@@ -560,6 +1006,7 @@ class DeviceTreeMirror:
         the flight sampler's 1 s gauge poll."""
         since = self._staged_since_m
         self._check_staleness_breach()
+        self._check_fallback_heartbeat()
         if since is None or self._state is None:
             return 0.0
         return max(0.0, (time.monotonic() - since) * 1000.0)
@@ -591,20 +1038,43 @@ class DeviceTreeMirror:
             self._sharding_mode, len(jax.local_devices())
         )
 
+    def _ensure_ladder(self) -> DeviceBackendLadder:
+        """The degradation ladder, resolved against the local device
+        complement on first use (tests may inject a pre-built one)."""
+        if self._ladder is None:
+            self._ladder = DeviceBackendLadder(
+                self._resolve_shards(),
+                degrade_after=self._degrade_after,
+            )
+        return self._ladder
+
     def _build_state(self, items):
-        """State factory — the pluggable backend seam. The pump, staging,
-        and every query path drive whichever state this returns through the
-        identical DeviceMerkleState surface."""
-        d = self._resolve_shards()
-        if d <= 0:
-            from merklekv_tpu.merkle.incremental import DeviceMerkleState
-
-            return DeviceMerkleState.from_items(items)
-        from merklekv_tpu.parallel.sharded_state import (
-            ShardedDeviceMerkleState,
-        )
-
-        return ShardedDeviceMerkleState.from_items(items, shards=d)
+        """State factory — the pluggable backend seam, now riding the
+        degradation ladder: build at the current rung; a rung whose
+        guarded dispatch fails steps the ladder down IMMEDIATELY (a build
+        failure means the rung cannot serve at all — counting to the
+        drain threshold would just repeat the cliff) and the build retries
+        one rung lower. The CPU golden rung is infallible, so this always
+        returns a serving state."""
+        items = list(items)
+        ladder = self._ensure_ladder()
+        while True:
+            rung = ladder.current()
+            try:
+                st = build_state_for_rung(rung, items)
+                ladder.note_success()
+                return st
+            except BaseException as e:
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
+                if rung <= 0:
+                    raise  # a CPU-rung failure is a bug, not weather
+                kind = (
+                    e.kind
+                    if isinstance(e, DeviceDispatchError)
+                    else classify_exception(e)
+                )
+                ladder.note_failure(kind, "build", immediate=True)
 
     def _load_state(self):
         return self._build_state(self._engine.snapshot())

@@ -343,6 +343,12 @@ class ClusterNode:
                     max_staleness_versions=(
                         self._cfg.device.max_staleness_versions
                     ),
+                    dispatch_deadline_ms=(
+                        self._cfg.device.dispatch_deadline_ms
+                    ),
+                    scrub_interval_s=self._cfg.device.scrub_interval_s,
+                    scrub_keys=self._cfg.device.scrub_keys,
+                    degrade_after=self._cfg.device.degrade_after_failures,
                 )
             storage = self._storage
             if storage is not None:
@@ -763,6 +769,11 @@ class ClusterNode:
                 mirror = self._mirror
             return mirror.shard_count() if mirror is not None else -1
 
+        def backend_level() -> int:
+            with self._rep_mu:
+                mirror = self._mirror
+            return mirror.backend_level() if mirror is not None else -1
+
         def shard_rebuild_us() -> int:
             with self._rep_mu:
                 mirror = self._mirror
@@ -815,6 +826,10 @@ class ClusterNode:
              "Dispatch cost of the last sharded subtree rebuild in "
              "microseconds (async enqueue; -1: single-device backend or "
              "no rebuild yet).", ""),
+            ("device.backend_level", backend_level,
+             "Degradation-ladder rung serving the Merkle tree (N>=2: "
+             "sharded width; 1: single-device; 0: CPU golden tree; -1: "
+             "native fallback / warming / no mirror).", ""),
             ("replication.outbox_depth", outbox_depth,
              "Events queued in the transport outbox awaiting a broker "
              "heal.", ""),
@@ -909,6 +924,12 @@ class ClusterNode:
         # scraping /metrics. Integer-text contract like every METRICS line.
         with self._rep_mu:
             mirror = self._mirror
+        if mirror is not None:
+            # Deliberately OUTSIDE the ready() gate below: the backend
+            # level is most interesting exactly when the mirror is NOT
+            # ready (-1 = serving off the native fallback — top's BKND
+            # column must show the degradation, not hide it).
+            lines.append(f"device.backend_level:{mirror.backend_level()}")
         if mirror is not None and mirror.ready():
             # Gated on ready(): a warming mirror has no published tree, and
             # tree_version 0 would read as "202 versions stale" in top's

@@ -40,6 +40,8 @@ __all__ = [
     "REPLICATOR_PUBLISH",
     "BOOTSTRAP_FETCH",
     "SERVER_BUSY",
+    "DEVICE_DISPATCH",
+    "DEVICE_HEAL",
     "RETRYABLE_ERRORS",
 ]
 
@@ -195,6 +197,26 @@ BOOTSTRAP_FETCH = RetryPolicy(
     attempts=4,
     op_timeout=30.0,
     op_deadline=600.0,
+)
+
+# Device dispatch guard (merklekv_tpu.device.guard): ONE near-immediate
+# retry when a device program call fails with an environment-classified
+# error (backend RPC blip, transient tunnel reset) — a second failure
+# escalates to the degradation ladder instead of retrying into a sick
+# backend. Hangs are never retried: the abandoned executor already spent
+# the dispatch deadline, and the pump's stall budget is the deadline, not
+# a multiple of it.
+DEVICE_DISPATCH = RetryPolicy(
+    first_delay=0.05, max_delay=0.5, jitter=0.2, attempts=2, op_timeout=5.0
+)
+
+# Device-plane re-warm probe (degradation-ladder heal): escalating backoff
+# between probes of a higher rung while the node serves from a degraded
+# backend. First probe comes quickly (most faults are transient backend
+# hiccups); a persistently sick device plane backs the probing off to once
+# a minute so the probe dispatches themselves never become load.
+DEVICE_HEAL = RetryPolicy(
+    first_delay=2.0, max_delay=60.0, multiplier=2.0, jitter=0.2
 )
 
 # Overload shed (ERROR BUSY -> client.ServerBusyError): the server asked

@@ -360,6 +360,23 @@ class DeviceConfig:
     # (0 = wall-window-only). Also the lag past which anti-entropy walkers
     # escalate a stale donor tree to a forced refresh.
     max_staleness_versions: int = 0
+    # Fault containment (merklekv_tpu/device/): every device program call
+    # runs deadline-guarded on a dedicated executor; a dispatch wedged past
+    # this bound is ABANDONED (typed error, never a hung pump/query
+    # thread). Must comfortably exceed the backend's worst first-use
+    # COMPILE time — an undersized deadline reads a legitimate compile as
+    # a hang and degrades the mesh for nothing (docs/DEPLOYMENT.md
+    # "Device fault containment"). 0 disables the executor bound.
+    dispatch_deadline_ms: float = 60_000.0
+    # Consecutive environment-classified drain failures at one ladder rung
+    # before stepping down (sharded(N) -> ... -> single-device -> CPU).
+    degrade_after_failures: int = 2
+    # Integrity scrub: every interval, cross-check a sampled leaf range of
+    # the SERVED device tree against CPU golden hashes recomputed from the
+    # engine — silent device corruption triggers invalidate+rebuild
+    # instead of serving a wrong root into anti-entropy. 0 disables.
+    scrub_interval_s: float = 30.0
+    scrub_keys: int = 256
 
 
 @dataclass
@@ -533,6 +550,38 @@ class Config:
             raise ValueError(
                 "[device] max_staleness_versions must be >= 0 (0 = wall "
                 f"window only), got {cfg.device.max_staleness_versions}"
+            )
+        if "dispatch_deadline_ms" in dev:
+            cfg.device.dispatch_deadline_ms = float(
+                dev["dispatch_deadline_ms"]
+            )
+        if cfg.device.dispatch_deadline_ms < 0:
+            raise ValueError(
+                "[device] dispatch_deadline_ms must be >= 0 (0 = "
+                f"unbounded), got {cfg.device.dispatch_deadline_ms}"
+            )
+        if "degrade_after_failures" in dev:
+            cfg.device.degrade_after_failures = int(
+                dev["degrade_after_failures"]
+            )
+        if cfg.device.degrade_after_failures < 1:
+            raise ValueError(
+                "[device] degrade_after_failures must be >= 1, got "
+                f"{cfg.device.degrade_after_failures}"
+            )
+        if "scrub_interval_s" in dev:
+            cfg.device.scrub_interval_s = float(dev["scrub_interval_s"])
+        if cfg.device.scrub_interval_s < 0:
+            raise ValueError(
+                "[device] scrub_interval_s must be >= 0 (0 = off), got "
+                f"{cfg.device.scrub_interval_s}"
+            )
+        if "scrub_keys" in dev:
+            cfg.device.scrub_keys = int(dev["scrub_keys"])
+        if cfg.device.scrub_keys < 1:
+            raise ValueError(
+                "[device] scrub_keys must be >= 1, got "
+                f"{cfg.device.scrub_keys}"
             )
         obs = raw.get("observability", {})
         if "http_port" in obs:

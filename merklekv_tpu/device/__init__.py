@@ -1,0 +1,12 @@
+"""Fault-contained device runtime under the serving Merkle tree plane.
+
+- :mod:`merklekv_tpu.device.guard` — deadline-guarded dispatch: every
+  device program call runs on a dedicated executor under a bounded
+  deadline, classified failures retry once, wedged dispatches are
+  abandoned so no serving thread can hang on the device plane.
+- :mod:`merklekv_tpu.device.ladder` — the degradation ladder: on repeated
+  dispatch failure the serving backend steps sharded(N) -> sharded(N/2)
+  -> ... -> single-device -> CPU golden tree (roots bit-identical at every
+  rung), with a background probe climbing back up under escalating
+  backoff.
+"""

@@ -1,0 +1,145 @@
+"""CpuMerkleState: the degradation ladder's terminal rung.
+
+A drop-in for :class:`merklekv_tpu.merkle.incremental.DeviceMerkleState`
+built ENTIRELY from the golden CPU tree (merkle/cpu.py) — no jax import,
+no device dispatch, nothing a sick accelerator plane can wedge. The
+degradation ladder (merklekv_tpu.device.ladder) falls back to it when
+every device rung has failed, so a node with a dead backend still serves
+HASH/TREELEVEL bit-identically (the levels ARE the reference tree — no
+promotion-chain correction needed) at host-hashing speed.
+
+Surface parity with DeviceMerkleState (the subset the mirror's pump,
+staging, and query paths drive): ``from_items`` / ``apply`` /
+``pending_count`` / ``flush_pending`` / ``root_hex(flush=)`` /
+``root_hash`` / ``level_nodes(level, lo, hi, flush=)`` / ``leaf_count`` /
+``leaf_digest``. ``_n_shards`` is 0 — the ``device.backend_level`` gauge's
+"CPU golden" code.
+
+Cost model: mutations update the leaf-hash map (O(batch) leaf hashing);
+interior levels rebuild lazily per publish generation (O(n) 64-byte node
+compressions, no leaf rehashing). That is the last-resort trade the ladder
+makes deliberately: correctness and liveness over the device plane's
+throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+from merklekv_tpu.merkle.cpu import build_levels, ref_level_sizes
+from merklekv_tpu.merkle.encoding import leaf_hash
+
+__all__ = ["CpuMerkleState"]
+
+
+class CpuMerkleState:
+    # Same staging ceiling as the device state: the mirror's PENDING_LIMIT
+    # auto-publish contract must hold on every rung.
+    PENDING_LIMIT = 65536
+
+    _n_shards = 0  # backend-level code: CPU golden rung
+
+    def __init__(self) -> None:
+        self._leaves: dict[bytes, bytes] = {}  # key -> 32-byte leaf hash
+        self._sorted: list[bytes] = []
+        self._levels: list[list[bytes]] = []
+        self._dirty = False
+        self._pending: dict[bytes, Optional[bytes]] = {}
+        # Attribution parity with the device state (tests/gauges read them).
+        self.full_rebuilds = 0
+        self.incremental_batches = 0
+        self.structural_batches = 0
+
+    # ------------------------------------------------------------ loading
+    @classmethod
+    def from_items(
+        cls, items: Iterable[tuple[bytes, bytes]]
+    ) -> "CpuMerkleState":
+        st = cls()
+        dedup = dict(items)
+        if dedup:
+            st._leaves = {k: leaf_hash(k, v) for k, v in dedup.items()}
+            st._dirty = True
+            st.full_rebuilds += 1
+        return st
+
+    def __len__(self) -> int:
+        self._flush()
+        return len(self._leaves)
+
+    def leaf_count(self) -> int:
+        # The leaf map only moves at flush, so this is the as-published
+        # count; staged pending changes don't count until their flush.
+        return len(self._leaves)
+
+    # ------------------------------------------------------------ updates
+    def apply(self, changes: Sequence[tuple[bytes, Optional[bytes]]]) -> None:
+        for k, v in changes:
+            self._pending[k] = v
+        if len(self._pending) >= self.PENDING_LIMIT:
+            self._flush()
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def flush_pending(self) -> None:
+        self._flush()
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, {}
+        structural = False
+        for k, v in pending.items():
+            if v is None:
+                structural |= self._leaves.pop(k, None) is not None
+            else:
+                structural |= k not in self._leaves
+                self._leaves[k] = leaf_hash(k, v)
+        self._dirty = True
+        if structural:
+            self.structural_batches += 1
+        else:
+            self.incremental_batches += 1
+
+    def _rebuild(self) -> None:
+        if not self._dirty:
+            return
+        self._sorted = sorted(self._leaves)
+        self._levels = build_levels([self._leaves[k] for k in self._sorted])
+        self._dirty = False
+
+    # ------------------------------------------------------------ queries
+    def root_hash(self, flush: bool = True) -> Optional[bytes]:
+        if flush:
+            self._flush()
+        self._rebuild()
+        return self._levels[-1][0] if self._levels else None
+
+    def root_hex(self, flush: bool = True) -> str:
+        r = self.root_hash(flush=flush)
+        return r.hex() if r is not None else "0" * 64
+
+    def leaf_digest(self, key: bytes) -> Optional[bytes]:
+        self._flush()
+        return self._leaves.get(key)
+
+    def level_nodes(
+        self, level: int, lo: int, hi: int, flush: bool = True
+    ) -> tuple[list[tuple[int, bytes]], int]:
+        """Reference-tree digests at ``level`` for ``[lo, hi)`` plus the
+        live leaf count — bit-identical to the device answer by
+        construction (these ARE the reference levels)."""
+        if flush:
+            self._flush()
+        self._rebuild()
+        n = len(self._sorted)
+        if n == 0:
+            return [], 0
+        sizes = ref_level_sizes(n)
+        if level >= len(sizes):
+            return [], n
+        m = sizes[level]
+        lo = max(0, min(lo, m))
+        hi = max(lo, min(hi, m))
+        return [(i, self._levels[level][i]) for i in range(lo, hi)], n

@@ -150,6 +150,7 @@ def divergence_masks_engine(
             mesh = None
     if mesh is None:
         return divergence_masks(digests, present)
+    from merklekv_tpu.device.guard import DeviceDispatchError, get_guard
     from merklekv_tpu.parallel.sharded_merkle import sharded_divergence
 
     d = int(mesh.shape["key"])
@@ -165,7 +166,20 @@ def divergence_masks_engine(
         )
     else:
         dig, pres = digests, present
-    masks, _counts = sharded_divergence(mesh, dig, pres)
+    try:
+        # Deadline-guarded like every serving-path device program: a sick
+        # mesh fails the dispatch at the guard instead of wedging the
+        # anti-entropy walk. Label follows the documented shard{N}_*
+        # scheme so chaos globs targeting the sharded rungs (shard*,
+        # shard8_*) reach this seam too.
+        masks, _counts = get_guard().run(
+            f"shard{d}_diff", lambda: sharded_divergence(mesh, dig, pres)
+        )
+    except DeviceDispatchError:
+        # The sharded program is an optimization, never the contract: the
+        # single-device comparison is bit-identical, so a faulted mesh
+        # sheds parallelism here, not the sync plane.
+        return divergence_masks(digests, present)
     return masks[:, :n] if pad else masks
 
 

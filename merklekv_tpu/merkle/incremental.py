@@ -40,6 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from merklekv_tpu.device.guard import get_guard
 from merklekv_tpu.merkle.jax_engine import leaf_digests
 from merklekv_tpu.obs.metrics import get_metrics
 from merklekv_tpu.ops.dispatch import (
@@ -202,6 +203,16 @@ class DeviceMerkleState:
     # Auto-flush ceiling: bounds the host memory pending values can hold.
     PENDING_LIMIT = 65536
 
+    # Dispatch-guard label prefix: every device program call routes through
+    # the process guard (merklekv_tpu.device.guard) under a label naming
+    # the seam — the chaos injector matches on it and the degradation
+    # ladder reads it out of the typed error. The sharded subclass prefixes
+    # its shard width so faults can target one rung.
+    _guard_prefix = ""
+
+    def _label(self, op: str) -> str:
+        return self._guard_prefix + op
+
     def __init__(self, sharding=None) -> None:
         self._keys = np.empty(0, dtype=object)  # sorted key bytes
         # key -> sorted position. np.searchsorted on an OBJECT array does a
@@ -310,7 +321,21 @@ class DeviceMerkleState:
         if not self._pending:
             return
         pending, self._pending = self._pending, {}
+        try:
+            self._flush_batch(pending)
+        except BaseException:
+            # A failed dispatch must not silently drop the batch: the tree
+            # is unchanged (the dispatch seams assign levels atomically on
+            # success), so restoring the staged changes keeps the state
+            # consistent for a retry — or for the degradation ladder's
+            # rebuild at a lower rung. Entries staged by a racing caller
+            # between the swap and here win over the restored batch.
+            merged = dict(pending)
+            merged.update(self._pending)
+            self._pending = merged
+            raise
 
+    def _flush_batch(self, pending: dict[bytes, Optional[bytes]]) -> None:
         # One membership pass (O(1) dict probes) classifies the whole batch.
         keys = sorted(pending)
         idx = self._index
@@ -360,9 +385,12 @@ class DeviceMerkleState:
 
         t0 = _time.perf_counter()
         fn = _scatter_hash_fn(self._capacity, kb, nblk, use_pallas())
-        self._levels = fn(
-            self._levels, jnp.asarray(idx), jnp.asarray(blocks),
-            jnp.asarray(nblocks),
+        self._levels = get_guard().run(
+            self._label("scatter"),
+            lambda: fn(
+                self._levels, jnp.asarray(idx), jnp.asarray(blocks),
+                jnp.asarray(nblocks),
+            ),
         )
         self.incremental_batches += 1
         m = get_metrics()
@@ -394,7 +422,13 @@ class DeviceMerkleState:
         # expensive — a span records batch size and transfer bytes per the
         # device-plane attribution the MTU throughput analysis needs.
         with span("device.rebuild", keys=n, capacity=c) as rec:
-            digests = np.asarray(leaf_digests(list(keys_arr), values))
+            # leaf_digests is itself a device dispatch (jitted leaf
+            # hashing) — guard it like every other program call, or a
+            # wedged backend hangs the warm thread with no deadline.
+            digests = get_guard().run(
+                self._label("build"),
+                lambda: np.asarray(leaf_digests(list(keys_arr), values)),
+            )
             padded = np.zeros((c, 8), np.uint32)
             padded[:n] = digests
             rec["bytes"] = int(padded.nbytes)
@@ -456,11 +490,19 @@ class DeviceMerkleState:
             fresh_pos = np.empty(kb, np.int32)
             fresh_pos[:k] = np.searchsorted(new_keys, fresh_keys)
             fresh_pos[k:] = fresh_pos[0]
-            digests = leaf_digests([key for key, _ in fresh_items],
-                                   [v for _, v in fresh_items])
-            fresh = jnp.concatenate(
-                [digests, jnp.broadcast_to(digests[0], (kb - k, 8))], axis=0
-            ) if kb > k else digests
+
+            # Guarded like the build path: the fresh-digest leaf hashing
+            # is a device dispatch and must not be able to wedge the
+            # pump thread outside the deadline.
+            def hash_fresh():
+                digests = leaf_digests([key for key, _ in fresh_items],
+                                       [v for _, v in fresh_items])
+                return jnp.concatenate(
+                    [digests, jnp.broadcast_to(digests[0], (kb - k, 8))],
+                    axis=0,
+                ) if kb > k else digests
+
+            fresh = get_guard().run(self._label("restructure"), hash_fresh)
         else:
             fresh_pos = np.zeros(0, np.int32)
             fresh = jnp.zeros((0, 8), jnp.uint32)
@@ -488,7 +530,10 @@ class DeviceMerkleState:
     # overrides them with explicit shard_map SPMD programs.
     def _dispatch_build(self, padded: np.ndarray) -> tuple:
         """Capacity-padded [C, 8] leaf digests -> every padded level."""
-        return _build_fn(len(padded), use_pallas())(self._put(padded))
+        fn = _build_fn(len(padded), use_pallas())
+        return get_guard().run(
+            self._label("build"), lambda: fn(self._put(padded))
+        )
 
     def _dispatch_restructure(
         self,
@@ -501,9 +546,12 @@ class DeviceMerkleState:
         """Gather survivors into shifted slots + scatter fresh digests +
         full re-reduction (``self._capacity`` still holds the OLD C)."""
         fn = _restructure_fn(self._capacity, c_new, kb, use_pallas())
-        return fn(
-            self._levels[0], self._put(gather_padded, one_d=True),
-            jnp.asarray(fresh_pos), fresh,
+        return get_guard().run(
+            self._label("restructure"),
+            lambda: fn(
+                self._levels[0], self._put(gather_padded, one_d=True),
+                jnp.asarray(fresh_pos), fresh,
+            ),
         )
 
     # ------------------------------------------------------------ queries
@@ -516,10 +564,13 @@ class DeviceMerkleState:
             self._flush()
         if not len(self._keys) or self._levels is None:
             return None
-        root = _ref_root_fn(self._capacity)(
-            self._levels, jnp.int32(len(self._keys))
+        fn = _ref_root_fn(self._capacity)
+        return get_guard().run(
+            self._label("root"),
+            lambda: digest_to_bytes(
+                np.asarray(fn(self._levels, jnp.int32(len(self._keys))))
+            ),
         )
-        return digest_to_bytes(np.asarray(root))
 
     def root_hex(self, flush: bool = True) -> str:
         r = self.root_hash(flush=flush)
@@ -530,7 +581,10 @@ class DeviceMerkleState:
         i = self._find(key)
         if i < 0 or self._levels is None:
             return None
-        return digest_to_bytes(np.asarray(self._levels[0][i]))
+        return get_guard().run(
+            self._label("levels"),
+            lambda: digest_to_bytes(np.asarray(self._levels[0][i])),
+        )
 
     # ------------------------------------------- reference-level serving
     @staticmethod
@@ -591,11 +645,18 @@ class DeviceMerkleState:
         hi = max(lo, min(hi, m))
         if lo == hi:
             return [], n
+
         # One device gather for the whole slice (the padded level's prefix
-        # matches the reference level everywhere but the last position).
-        block = np.asarray(self._levels[level][lo:hi])
-        digs = digests_to_bytes(block)
-        rows = [(lo + i, d) for i, d in enumerate(digs)]
-        if hi == m and level > 0:
-            rows[-1] = (m - 1, self._promoted_last(level))
-        return rows, n
+        # matches the reference level everywhere but the last position);
+        # guarded so a TREELEVEL serve against a wedged device fails at the
+        # dispatch deadline (and the native fallback answers) instead of
+        # parking the query thread forever.
+        def read() -> list[tuple[int, bytes]]:
+            block = np.asarray(self._levels[level][lo:hi])
+            digs = digests_to_bytes(block)
+            rows = [(lo + i, d) for i, d in enumerate(digs)]
+            if hi == m and level > 0:
+                rows[-1] = (m - 1, self._promoted_last(level))
+            return rows
+
+        return get_guard().run(self._label("levels"), read), n

@@ -263,6 +263,63 @@ void stress_pipelined_pool() {
   server.wait();
 }
 
+// Device fault-containment analog (ISSUE 13): the guard executor, the
+// mirror pump, the integrity scrub, and the heal-probe warm thread all
+// read the engine (get / version / snapshot) from their OWN threads while
+// io-driven writers mutate it and stamped tree queries force host-cache
+// rebuilds — the cross-thread seam the degradation ladder adds on top of
+// the PR 11 pump overlap. Engine locks must keep every combination clean.
+void stress_guard_pump_scrub() {
+  mkv::MemEngine engine;
+  for (int i = 0; i < 256; ++i) {
+    engine.set("scrub:" + std::to_string(i), "v");
+  }
+  mkv::ServerOptions opts;
+  opts.port = 0;
+  opts.io_threads = 4;
+  mkv::Server server(&engine, opts);
+  if (!server.start()) {
+    std::fprintf(stderr, "bind failed\n");
+    std::exit(1);
+  }
+  server.set_events_enabled(true);
+  std::atomic<bool> running{true};
+  // Scrub thread: version fence -> sampled gets -> version fence (the
+  // quiescence check scrub_once runs under the mirror lock).
+  std::thread scrubber([&] {
+    while (running.load(std::memory_order_acquire)) {
+      uint64_t v0 = engine.version();
+      for (int i = 0; i < 32; ++i) {
+        engine.get("scrub:" + std::to_string(i % 256));
+      }
+      (void)(engine.version() == v0);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  // Warm/heal-probe thread: whole-keyspace snapshot + watermark reads,
+  // concurrent with the write storm (the replace-warm's build input).
+  std::thread warmer([&] {
+    for (int i = 0; i < 40; ++i) {
+      engine.version();
+      engine.snapshot();
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  });
+  std::vector<std::thread> clients;
+  for (int t = 0; t < 8; ++t) {
+    clients.emplace_back(pipelined_worker, server.port(), t, 30, 16);
+  }
+  // Pump-shaped stamped queries (forced TREELEVEL rebuilds ride tree_mu_).
+  clients.emplace_back(pump_worker, server.port(), 150);
+  clients.emplace_back(pump_worker, server.port(), 150);
+  for (auto& t : clients) t.join();
+  running.store(false, std::memory_order_release);
+  scrubber.join();
+  warmer.join();
+  server.stop();
+  server.wait();
+}
+
 void stress_stop_races() {
   // stop() racing live connections + fresh connects: the historical hazard
   // (accept/stop handshake, clients_ table vs handler deregistration).
@@ -348,6 +405,8 @@ int main() {
   std::fprintf(stderr, "server traffic: ok\n");
   stress_pipelined_pool();
   std::fprintf(stderr, "pipelined pool: ok\n");
+  stress_guard_pump_scrub();
+  std::fprintf(stderr, "guard/pump/scrub readers: ok\n");
   stress_stop_races();
   std::fprintf(stderr, "stop races: ok\n");
   std::puts("TSAN STRESS PASS");

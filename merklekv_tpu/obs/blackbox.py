@@ -16,7 +16,9 @@ complement of the live ``top``/``trace`` surfaces:
 - **anomalies**: degradation-ladder flips, storage full latches,
   peer-health flips, sync-cycle errors, slow-command bursts (>= 3 within
   10 s), skew-clamp bursts, admission-rejection bursts, device-tree
-  staleness breaches (wedged update pump), and lag spikes
+  staleness breaches (wedged update pump), device-backend ladder
+  step-downs / fallback-serving heartbeats / scrub-caught corruption
+  (with the environment|code classified kind), and lag spikes
   from the sampled ``replication.lag_events.*`` series.
 
 - **fatal context**: ``fatal.txt`` crash markers (native signal stamps)
@@ -284,6 +286,26 @@ def find_anomalies(
                 f"pump lag {f.get('lag_ms')}ms / "
                 f"{f.get('lag_versions')} versions "
                 f"(window {f.get('window_ms')}ms)")
+        elif ev.kind == "device_degraded":
+            # The device degradation ladder stepped down a rung; the
+            # classified kind says whether it was backend weather
+            # (environment) or a code failure that should page.
+            add(e, "device_degraded",
+                f"rung {f.get('from_rung')} -> {f.get('to_rung')} "
+                f"({f.get('kind')} @ {f.get('where')})")
+        elif ev.kind == "device_fallback":
+            # Heartbeat (one per 10s window): a previously ready mirror is
+            # serving off the NATIVE fallback — invalidated and not yet
+            # re-warmed. Visible here so fallback serving is never silent.
+            add(e, "device_fallback",
+                f"serving native fallback (ladder rung {f.get('rung')})")
+        elif ev.kind == "device_corruption":
+            # The integrity scrub caught the served device tree diverging
+            # from the engine — silent corruption; invalidate+rebuild was
+            # triggered.
+            add(e, "device_corruption",
+                f"scrub mismatch at leaf {f.get('leaf_index')} "
+                f"(rung {f.get('rung')})")
         elif ev.kind in ("admission_reject", "pipeline_reject",
                          "events_dropped"):
             add(e, "rejection_burst", f"{ev.kind} +{f.get('count')}")

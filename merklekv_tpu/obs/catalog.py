@@ -174,6 +174,35 @@ CATALOG: dict[str, tuple[str, str]] = {
     "device.shard_rebuild_dispatch": (
         "histogram", "Sharded subtree rebuild dispatch (async enqueue) "
         "latency over the key mesh."),
+    "device.backend_level": (
+        "gauge", "Degradation-ladder rung serving the Merkle tree (N>=2: "
+        "sharded width; 1: single-device; 0: CPU golden tree; -1: native "
+        "fallback / warming / no mirror)."),
+    "device.guard_timeouts": (
+        "counter", "Guarded device dispatches abandoned at the [device] "
+        "dispatch_deadline_ms bound (the wedged worker is orphaned; the "
+        "caller gets a typed hang error)."),
+    "device.guard_retries": (
+        "counter", "Guarded device dispatches retried once after an "
+        "environment-classified failure (transient backend blip)."),
+    "device.guard_errors": (
+        "counter", "Guarded device dispatches that failed past the retry "
+        "budget (typed DeviceDispatchError raised to the caller)."),
+    "device.degraded_total": (
+        "counter", "Degradation-ladder step-downs (device_degraded flight "
+        "events carry the rung transition and classified kind)."),
+    "device.healed_total": (
+        "counter", "Degradation-ladder climbs after a successful re-warm "
+        "probe (device_healed flight events)."),
+    "device.heal_probes": (
+        "counter", "Re-warm probe attempts against a higher ladder rung "
+        "(escalating backoff while degraded)."),
+    "device.scrub_checks": (
+        "counter", "Integrity-scrub passes that reached a verdict (served "
+        "device leaf range cross-checked against CPU golden hashes)."),
+    "device.scrub_mismatches": (
+        "counter", "Integrity-scrub corruption detections (served device "
+        "tree diverged from the engine; invalidate+rebuild triggered)."),
     "profiler.captures": (
         "counter", "PROFILE verb device-profiler captures started."),
     # -- flight recorder ---------------------------------------------------

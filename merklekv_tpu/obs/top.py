@@ -5,12 +5,14 @@ wire protocol (no exporter needed), computes per-interval rates from
 successive counter samples, and renders one table per refresh:
 
     NODE  KEYS  OPS/S  SET/S  GET/S  P50_US  SYNC_KB/S  CONNS  W  OPS/S/W
-    PEERS_UP  LAG_EV  LAG_MS  STALE  VER  READY  STATE  SHED/S  STATUS
+    PEERS_UP  LAG_EV  LAG_MS  STALE  VER  BKND  READY  STATE  SHED/S  STATUS
 
 (CONNS = active connections; W = epoll worker-pool width; OPS/S/W = the
 busiest io worker's command rate, the pool-imbalance signal; STALE = the
 device pump's worst lag in ms; VER = engine-vs-served tree version delta —
-how many mutations the served Merkle tree trails live by.)
+how many mutations the served Merkle tree trails live by; BKND = the
+device degradation-ladder rung serving the tree: sharded width, 1 =
+single-device, 0 = CPU golden, -1 = native fallback.)
 
 ``--once`` prints a single frame (two quick samples for rates) and exits —
 scriptable and testable; without it the screen refreshes every
@@ -73,6 +75,11 @@ class NodeSample:
     pump_lag_ms: int = -1
     tree_version: int = -1
     engine_version: int = -1
+    # Device fault-containment plane (METRICS device.backend_level line):
+    # the degradation-ladder rung serving the tree — N>=2 sharded width,
+    # 1 single-device, 0 CPU golden, -1 native fallback; -2 = the line is
+    # absent (node predates the ladder / no mirror), rendered "-".
+    backend_level: int = -2
     # io plane (STATS io_threads / io_worker_<i>_commands lines): pool
     # width and per-worker cumulative command counts — rendered as the W
     # and OPS/S/W (busiest worker's rate) columns ("-" on nodes predating
@@ -173,6 +180,7 @@ def sample_node(
         ("pump_lag_ms", "device.pump_lag_ms"),
         ("tree_version", "device.tree_version"),
         ("engine_version", "node.engine_version"),
+        ("backend_level", "device.backend_level"),
     ):
         try:
             setattr(s, attr, int(metrics[key]))
@@ -232,7 +240,7 @@ def render_table(
         f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONNS':>5} {'W':>3} "
         f"{'OPS/S/W':>8} {'PEERS_UP':>9} "
         f"{'LAG_EV':>7} {'LAG_MS':>8} {'STALE':>6} {'VER':>5} "
-        f"{'READY':>8} {'STATE':>9} "
+        f"{'BKND':>5} {'READY':>8} {'STATE':>9} "
         f"{'SHED/S':>7} STATUS"
     )
     lines = [header, "-" * len(header)]
@@ -243,7 +251,7 @@ def render_table(
             lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
                          f"{'-':>7} {'-':>10} {'-':>5} {'-':>3} {'-':>8} "
                          f"{'-':>9} "
-                         f"{'-':>7} {'-':>8} {'-':>6} {'-':>5} "
+                         f"{'-':>7} {'-':>8} {'-':>6} {'-':>5} {'-':>5} "
                          f"{'-':>8} {'-':>9} {'-':>7} "
                          f"DOWN ({c.error})")
             continue
@@ -277,12 +285,16 @@ def render_table(
             if c.tree_version >= 0 and c.engine_version >= 0
             else "-"
         )
+        # BKND = degradation-ladder rung (sharded width / 1 / cpu=0 /
+        # fallback=-1); "-" on nodes predating the ladder or without a
+        # mirror.
+        bknd = f"{c.backend_level}" if c.backend_level >= -1 else "-"
         lines.append(
             f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
             f"{p50:>7} {sync_kb:>10.1f} {c.active_connections:>5} "
             f"{w:>3} {per_worker:>8.1f} "
             f"{peers:>9} {c.lag_events:>7} {c.lag_ms:>8.1f} "
-            f"{stale:>6} {ver:>5} "
+            f"{stale:>6} {ver:>5} {bknd:>5} "
             f"{c.readiness:>8} {c.state:>9} {shed:>7.1f} UP"
         )
     return "\n".join(lines)

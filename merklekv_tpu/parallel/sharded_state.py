@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from merklekv_tpu.device.guard import get_guard
 from merklekv_tpu.merkle.incremental import DeviceMerkleState, _bucket
 from merklekv_tpu.obs.metrics import get_metrics
 from merklekv_tpu.ops.dispatch import use_pallas
@@ -154,6 +155,14 @@ class ShardedDeviceMerkleState(DeviceMerkleState):
     def shard_count(self) -> int:
         return self._n_shards
 
+    @property
+    def _guard_prefix(self) -> str:
+        """Dispatch-guard labels carry the shard width (``shard8_build``,
+        ``shard2_scatter``, ...) so the chaos injector can fault ONE rung
+        of the degradation ladder (``shard8_*``) or every sharded rung
+        (``shard*``) while the single-device labels stay clean."""
+        return f"shard{self._n_shards}_"
+
     # -------------------------------------------------- device dispatch
     def _put_routed(self, arr: np.ndarray) -> jax.Array:
         """[D, ...] per-shard-routed host array -> device, dim 0 on the
@@ -175,7 +184,9 @@ class ShardedDeviceMerkleState(DeviceMerkleState):
             self._mesh, self._axis, len(padded), use_pallas()
         )
         t0 = time.perf_counter()
-        levels = fn(self._put(padded))
+        levels = get_guard().run(
+            self._label("build"), lambda: fn(self._put(padded))
+        )
         self._record_rebuild(t0)
         return levels
 
@@ -186,9 +197,12 @@ class ShardedDeviceMerkleState(DeviceMerkleState):
             self._mesh, self._axis, self._capacity, c_new, kb, use_pallas()
         )
         t0 = time.perf_counter()
-        levels = fn(
-            self._levels[0], self._put(gather_padded, one_d=True),
-            jnp.asarray(fresh_pos), fresh,
+        levels = get_guard().run(
+            self._label("restructure"),
+            lambda: fn(
+                self._levels[0], self._put(gather_padded, one_d=True),
+                jnp.asarray(fresh_pos), fresh,
+            ),
         )
         self._record_rebuild(t0)
         return levels
@@ -232,11 +246,14 @@ class ShardedDeviceMerkleState(DeviceMerkleState):
         fn = sharded_scatter_program(
             self._mesh, self._axis, self._capacity, kb, nblk, use_pallas()
         )
-        self._levels = fn(
-            *self._levels[:n_local],
-            self._put_routed(idx),
-            self._put_routed(blocks),
-            self._put_routed(nblocks),
+        self._levels = get_guard().run(
+            self._label("scatter"),
+            lambda: fn(
+                *self._levels[:n_local],
+                self._put_routed(idx),
+                self._put_routed(blocks),
+                self._put_routed(nblocks),
+            ),
         )
         self.incremental_batches += 1
         m = get_metrics()

@@ -1,0 +1,312 @@
+"""Device-plane fault containment (ISSUE 13): mirror-level chaos.
+
+Per-rung ladder transitions on the virtual 8-device CPU mesh (conftest):
+injected persistent failures at shard widths 8 / 2 / 1 walk the serving
+backend down sharded(N) -> single-device -> CPU golden with BIT-IDENTICAL
+roots and MONOTONE version stamps at every transition; a hang injection
+proves the pump-alive invariant (queries never block on the dispatch
+deadline); the integrity scrub catches injected silent corruption; the
+re-warm probe climbs back to sharded(N) after heal; invalidate() leaves a
+heartbeat in the flight timeline instead of going silent. A slow soak
+cycles inject/heal repeatedly and checks for thread leaks.
+"""
+
+import threading
+import time
+
+import pytest
+
+from merklekv_tpu.cluster.change_event import ChangeEvent, OpKind
+from merklekv_tpu.cluster.mirror import DeviceTreeMirror
+from merklekv_tpu.cluster.retry import RetryPolicy
+from merklekv_tpu.device.guard import configure as configure_guard
+from merklekv_tpu.device.ladder import DeviceBackendLadder
+from merklekv_tpu.merkle.cpu import build_levels
+from merklekv_tpu.merkle.encoding import leaf_hash
+from merklekv_tpu.native_bindings import NativeEngine
+from merklekv_tpu.testing.device_faults import DeviceFaultInjector
+
+N_KEYS = 96
+FAST_HEAL = RetryPolicy(first_delay=0.05, max_delay=0.2, jitter=0.0)
+
+
+def _golden_root(eng) -> str:
+    items = dict(eng.snapshot())
+    return build_levels(
+        [leaf_hash(k, v) for k, v in sorted(items.items())]
+    )[-1][0].hex()
+
+
+def _engine() -> NativeEngine:
+    eng = NativeEngine()
+    for i in range(N_KEYS):
+        eng.set(b"lk:%04d" % i, b"v%d" % i)
+    return eng
+
+
+def _ev(key: bytes) -> ChangeEvent:
+    return ChangeEvent(
+        op=OpKind.SET, key=key.decode(), val=b"x", ts=1, src="t"
+    )
+
+
+def _mirror(eng, sharding="8", degrade_after=1, **kw) -> DeviceTreeMirror:
+    top = 0 if sharding in ("off", "1") else int(sharding)
+    ladder = DeviceBackendLadder(
+        top, degrade_after=degrade_after, heal_policy=FAST_HEAL
+    )
+    kw.setdefault("scrub_interval_s", 0.0)
+    kw.setdefault("max_staleness_ms", 50.0)
+    return DeviceTreeMirror(eng, sharding=sharding, ladder=ladder, **kw)
+
+
+def _wait(cond, timeout=120.0, poll=0.02) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def _warm(m) -> None:
+    m.start_warming()
+    assert _wait(m.ready), "mirror never warmed"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _prewarm_programs():
+    """Compile every program the ladder drills will dispatch (sharded-8
+    build/scatter/root/levels, single-device ditto, and the tiny
+    heal-probe shapes) so tests with tight deadlines measure dispatch,
+    not first-jit compile — an undersized deadline reads a compile as a
+    hang, which is exactly the production sizing rule DEPLOYMENT.md
+    documents."""
+    from merklekv_tpu.device.ladder import build_state_for_rung
+    from merklekv_tpu.merkle.incremental import DeviceMerkleState
+    from merklekv_tpu.parallel.sharded_state import ShardedDeviceMerkleState
+
+    configure_guard(deadline_ms=120_000)
+    items = [(b"lk:%04d" % i, b"v%d" % i) for i in range(N_KEYS)]
+    for st in (
+        ShardedDeviceMerkleState.from_items(items, shards=8),
+        ShardedDeviceMerkleState.from_items(items, shards=2),
+        DeviceMerkleState.from_items(items),
+    ):
+        st.apply([(b"lk:0000", b"prewarm")])
+        st.root_hex()
+        st.level_nodes(0, 0, 4)
+    for rung in (8, 2, 1):
+        build_state_for_rung(rung, [(b"mkv:heal-probe", b"ok")]).root_hex()
+    yield
+    configure_guard(deadline_ms=60_000)
+
+
+@pytest.mark.parametrize(
+    "sharding,match,expect_rung",
+    [
+        ("8", "shard8_*", 4),   # width-8 fault: largest healthy subset
+        ("8", "shard*", 1),     # every sharded width sick: single-device
+        ("2", "shard2_*", 1),
+        ("8", "*", 0),          # whole device plane sick: CPU golden
+    ],
+)
+def test_warm_build_lands_on_surviving_rung(sharding, match, expect_rung):
+    """A persistently faulted rung never serves: the warm build walks the
+    ladder and completes on the surviving backend, root bit-identical.
+    The width-8-only fault proves degrade-and-RESHARD: the mesh narrows
+    to the largest healthy power-of-two subset, not straight to one
+    device."""
+    eng = _engine()
+    m = _mirror(eng, sharding=sharding)
+    with DeviceFaultInjector(match=match, mode="fail"):
+        _warm(m)
+        assert m.backend_level() == expect_rung
+        assert m.published_root_hex() == _golden_root(eng)
+        rows, n = m.level_nodes(0, 0, 8)
+        assert n == N_KEYS and len(rows) == 8
+    m.close()
+
+
+def test_drain_failure_degrades_stamps_monotone_then_reclimbs():
+    """The acceptance drill: persistent sharded failure mid-serve ->
+    rung-by-rung degrade to single-device with bit-identical roots and
+    monotone stamps -> heal -> probe reclimbs to sharded(8) -> fresh
+    writes serve bit-identically at full width."""
+    from merklekv_tpu.obs.flightrec import get_recorder
+
+    eng = _engine()
+    m = _mirror(eng, sharding="8")
+    _warm(m)
+    assert m.backend_level() == 8
+    assert m.published_root_hex() == _golden_root(eng)
+    v0 = m.published_version()
+
+    inj = DeviceFaultInjector(match="shard*", mode="fail").install()
+    try:
+        eng.set(b"lk:0000", b"CHANGED")
+        m.on_events([_ev(b"lk:0000")], watermark=eng.version())
+        assert _wait(
+            lambda: m.ready()
+            and m.backend_level() == 1
+            and m.staleness() == 0
+        ), f"never contained at single-device (rung {m.backend_level()})"
+        assert m.published_root_hex() == _golden_root(eng)
+        v1 = m.published_version()
+        assert v1 >= v0, "version stamp went backwards across degrade"
+        kinds = [e.kind for e in get_recorder().last(100)]
+        assert "device_degraded" in kinds
+    finally:
+        inj.heal()
+
+    assert _wait(lambda: m.backend_level() == 8), "never reclimbed"
+    kinds = [e.kind for e in get_recorder().last(100)]
+    assert "device_healed" in kinds
+    eng.set(b"lk:0001", b"AFTERHEAL")
+    m.on_events([_ev(b"lk:0001")], watermark=eng.version())
+    assert _wait(
+        lambda: m.staleness() == 0
+        and m.published_root_hex() == _golden_root(eng)
+    )
+    assert m.published_version() >= v1
+    inj.uninstall()
+    m.close()
+
+
+def test_hang_injection_pump_alive_queries_never_block():
+    """The rc=124 shape, contained: a dispatch wedged past the deadline is
+    abandoned — queries keep answering the published snapshot instantly,
+    the pump thread survives, and the ladder lands on the surviving
+    backend."""
+    eng = _engine()
+    m = _mirror(eng, sharding="8", dispatch_deadline_ms=400)
+    with DeviceFaultInjector(match="shard*", mode="hang", hang_s=1.2):
+        _warm(m)  # warm itself rides the ladder through the hang
+        assert m.backend_level() == 1
+        # Stage into a now-clean backend; then hang only sharded widths,
+        # so serving stays live while heal probes keep timing out.
+        eng.set(b"lk:0002", b"HUNG")
+        m.on_events([_ev(b"lk:0002")], watermark=eng.version())
+        t0 = time.perf_counter()
+        root = m.published_root_hex()
+        dt = time.perf_counter() - t0
+        assert root is not None
+        assert dt < 0.35, f"query waited {dt:.3f}s (deadline is 0.4s)"
+        assert _wait(lambda: m.staleness() == 0, timeout=30)
+        assert m.published_root_hex() == _golden_root(eng)
+        assert m._pump_thread is not None and m._pump_thread.is_alive()
+    assert _wait(lambda: m.backend_level() == 8, timeout=60)
+    assert m.published_root_hex() == _golden_root(eng)
+    time.sleep(1.3)  # let abandoned guard workers drain before teardown
+    m.close()
+
+
+def test_scrub_detects_silent_corruption_and_repairs():
+    eng = _engine()
+    m = _mirror(eng, sharding="8", degrade_after=3)
+    _warm(m)
+    m._scrub_keys = 1 << 20  # whole-keyspace sample: deterministic hit
+    assert _wait(lambda: m.staleness() == 0, timeout=30)
+    assert m.scrub_once() is True, "clean tree must scrub clean"
+
+    inj = DeviceFaultInjector(match="shard*scatter", mode="corrupt")
+    with inj:
+        eng.set(b"lk:0003", b"CORRUPT")
+        m.on_events([_ev(b"lk:0003")], watermark=eng.version())
+        assert _wait(lambda: m.staleness() == 0 and inj.corruptions > 0,
+                     timeout=30)
+        inj.heal()
+        assert m.scrub_once() is False, "scrub missed the flipped leaf"
+    # invalidate + rebuild repaired it; the scrub counters moved.
+    assert _wait(
+        lambda: m.ready() and m.published_root_hex() == _golden_root(eng)
+    )
+    from merklekv_tpu.obs.metrics import get_metrics
+
+    counters = get_metrics().snapshot()["counters"]
+    assert counters.get("device.scrub_mismatches", 0) >= 1
+    from merklekv_tpu.obs.flightrec import get_recorder
+
+    assert any(
+        e.kind == "device_corruption" for e in get_recorder().last(100)
+    )
+    m.close()
+
+
+def test_invalidate_leaves_fallback_heartbeat_not_silence():
+    """The PR small fix: a node serving off the native fallback after
+    invalidate() must heartbeat into the flight timeline (one event per
+    10 s window), driven by the same gauge poll the flight sampler runs."""
+    from merklekv_tpu.obs.flightrec import get_recorder
+
+    eng = _engine()
+    m = _mirror(eng, sharding="off")
+    _warm(m)
+    base = sum(
+        1 for e in get_recorder().last(200) if e.kind == "device_fallback"
+    )
+    m.invalidate()
+    m.pump_lag_ms()  # the sampler's 1 s gauge poll path
+    m.pump_lag_ms()  # second poll inside the window: no duplicate
+    beats = [
+        e for e in get_recorder().last(200) if e.kind == "device_fallback"
+    ]
+    assert len(beats) == base + 1, "exactly one heartbeat per flag window"
+    assert beats[-1].fields.get("rung") is not None
+    m.close()
+
+
+def test_node_metrics_backend_level_line_rendered_in_top():
+    """The device.backend_level METRICS line parses into top's BKND
+    column (and absent lines render '-' for pre-ladder nodes)."""
+    from merklekv_tpu.obs.top import NodeSample, render_table
+
+    s = NodeSample(node="n1", ok=True, unix=1.0)
+    s.backend_level = 1
+    old = NodeSample(node="n2", ok=True, unix=1.0)  # pre-ladder node
+    table = render_table({}, {"n1": s, "n2": old})
+    lines = table.splitlines()
+    header = lines[0].split()
+    idx = header.index("BKND")
+    n1_row = [ln for ln in lines if ln.startswith("n1")][0].split()
+    n2_row = [ln for ln in lines if ln.startswith("n2")][0].split()
+    assert n1_row[idx] == "1"
+    assert old.backend_level == -2 and n2_row[idx] == "-"
+
+
+@pytest.mark.slow
+def test_soak_repeated_inject_heal_cycles_no_thread_leak():
+    """Repeated fault/heal cycles: every cycle degrades to the surviving
+    backend and reclimbs bit-identically; thread count stays bounded (no
+    leaked pump/warm/guard workers)."""
+    eng = _engine()
+    m = _mirror(eng, sharding="8")
+    _warm(m)
+    baseline_threads = threading.active_count()
+    for cycle in range(4):
+        inj = DeviceFaultInjector(match="shard*", mode="fail").install()
+        try:
+            key = b"lk:%04d" % (cycle % N_KEYS)
+            eng.set(key, b"soak%d" % cycle)
+            m.on_events([_ev(key)], watermark=eng.version())
+            assert _wait(
+                lambda: m.ready()
+                and m.backend_level() == 1
+                and m.staleness() == 0
+            ), f"cycle {cycle}: never contained"
+            assert m.published_root_hex() == _golden_root(eng)
+        finally:
+            inj.heal()
+        assert _wait(lambda: m.backend_level() == 8), (
+            f"cycle {cycle}: never reclimbed"
+        )
+        assert _wait(
+            lambda: m.published_root_hex() == _golden_root(eng)
+        )
+        inj.uninstall()
+    # Warm/pump/guard threads are reused or reaped — a few in flight is
+    # fine, monotone growth is the leak this guards against.
+    assert threading.active_count() <= baseline_threads + 4, (
+        f"thread leak: {baseline_threads} -> {threading.active_count()}"
+    )
+    m.close()

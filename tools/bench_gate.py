@@ -13,7 +13,10 @@ scenarios) must not DROP; latency/size/overhead units (``ms``, ``us``,
 ``bytes``, ``%``) must not RISE. Rounds that crashed (rc != 0, no scenarios, null values)
 are skipped rather than compared — a broken round is the driver's failure
 signal, not a baseline; with fewer than two usable rounds the gate warns
-and passes.
+and passes. Failed rounds carrying the structured ``error_kind`` verdict
+(shared classifier, merklekv_tpu/utils/errorkind.py) are skipped WITH the
+reason: ``environment`` reads as driver weather (BENCH_r05's wedged
+backend init), ``code`` as something to look at.
 
 Usage: ``python tools/bench_gate.py [--dir .] [--threshold 0.2] [files..]``
 """
@@ -27,7 +30,8 @@ import os
 import sys
 from typing import Optional
 
-__all__ = ["extract_scenarios", "lower_is_better", "compare", "main"]
+__all__ = ["extract_scenarios", "round_weather", "lower_is_better",
+           "compare", "main"]
 
 
 def extract_scenarios(record: dict) -> dict[str, dict]:
@@ -56,6 +60,42 @@ def extract_scenarios(record: dict) -> dict[str, dict]:
         for m, s in out.items()
         if isinstance(s.get("value"), (int, float)) and s["value"] > 0
     }
+
+
+def round_weather(record: dict) -> Optional[str]:
+    """The structured ``error_kind`` of a failed round, or None.
+
+    bench.py classifies every whole-run failure through the shared
+    environment|code table (merklekv_tpu/utils/errorkind.py) and stamps
+    the verdict on the error record — a BENCH_r05-shaped round (wedged
+    backend init, dead tunnel) then skips as ``environment`` WEATHER with
+    the reason printed, instead of an anonymous "no usable scenarios".
+    A ``code``-kind failure also skips (a broken round is never a
+    baseline) but the verdict says someone should look at it."""
+    for obj in ([record.get("parsed")] if isinstance(record.get("parsed"),
+                                                    dict) else []) + [
+        record
+    ]:
+        if obj.get("error") and obj.get("error_kind"):
+            return str(obj["error_kind"])
+    tail = record.get("tail") or ""
+    # Newest-first: a round can emit several error records (an early
+    # environment-kind backend-probe record, then a terminal code-kind
+    # crash record from main()) — the TERMINAL verdict is the round's
+    # verdict, so the last error_kind line wins.
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and obj.get("error") and obj.get(
+            "error_kind"
+        ):
+            return str(obj["error_kind"])
+    return None
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
@@ -125,8 +165,15 @@ def main(argv: Optional[list[str]] = None) -> int:
             continue
         scenarios = extract_scenarios(record)
         if not scenarios:
-            print(f"# {path}: no usable scenarios (rc="
-                  f"{record.get('rc')}); skipped", file=sys.stderr)
+            kind = round_weather(record)
+            why = (
+                f"error_kind={kind}"
+                if kind
+                else f"rc={record.get('rc')}"
+            )
+            tag = " as weather" if kind == "environment" else ""
+            print(f"# {path}: no usable scenarios ({why}); skipped{tag}",
+                  file=sys.stderr)
             continue
         usable.append((path, scenarios))
     if len(usable) < 2:
