@@ -118,6 +118,8 @@ def main(argv: list[str] | None = None) -> int:
     server = NativeServer(
         engine, cfg.host, cfg.port, version=__version__,
         exit_on_shutdown=False, io_threads=cfg.server.io_threads,
+        reuseport=cfg.server.reuseport, zero_copy=cfg.server.zero_copy,
+        max_line=cfg.server.max_line_bytes,
     )
     if cfg.storage.enabled:
         # BEFORE start(): stage change events from the very first accepted

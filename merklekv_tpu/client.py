@@ -186,10 +186,15 @@ def _is_trace_capability_error(msg: str) -> bool:
 
 
 class _ResponseReader:
-    """Incremental CRLF line splitter over a byte stream."""
+    """Incremental CRLF line splitter over a byte stream.
 
-    def __init__(self) -> None:
+    `limit` bounds the bytes buffered while waiting for a newline — the
+    sync-side enforcement of max_value_bytes, mirroring the async
+    client's StreamReader limit (None = unbounded)."""
+
+    def __init__(self, limit: Optional[int] = None) -> None:
         self._buf = b""
+        self._limit = limit
 
     def feed(self, data: bytes) -> None:
         self._buf += data
@@ -197,6 +202,12 @@ class _ResponseReader:
     def next_line(self) -> Optional[str]:
         i = self._buf.find(b"\n")
         if i < 0:
+            if self._limit is not None and len(self._buf) > self._limit:
+                raise ProtocolError(
+                    f"response line exceeds {len(self._buf) - 1} buffered "
+                    f"bytes without a newline — raise the client's "
+                    f"max_value_bytes to round-trip larger values"
+                )
             return None
         line = self._buf[: i + 1]
         self._buf = self._buf[i + 1 :]
@@ -216,12 +227,20 @@ class MerkleKVClient:
         host: str = "localhost",
         port: int = DEFAULT_PORT,
         timeout: float = 5.0,
+        max_value_bytes: int = 1 << 20,
     ) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
+        # Largest value this client expects to round-trip: bounds the
+        # line buffer exactly like the async client's StreamReader limit
+        # (same floor + header-slack formula), so both clients refuse an
+        # oversized VALUE line identically — here with a typed
+        # ProtocolError naming the knob instead of a bare ValueError.
+        self.max_value_bytes = max_value_bytes
+        self._line_limit = max(1 << 20, max_value_bytes + (1 << 16))
         self._sock: Optional[socket.socket] = None
-        self._reader = _ResponseReader()
+        self._reader = _ResponseReader(self._line_limit)
         # Wire-byte accounting (requests sent / response bytes received over
         # the connection's lifetime, reconnects included). The sync manager
         # reads deltas of these to report anti-entropy transfer cost — the
@@ -251,7 +270,7 @@ class MerkleKVClient:
     def connect(self) -> "MerkleKVClient":
         # Fresh line buffer: a reconnect must not inherit half-parsed (or
         # desynchronized) bytes from the previous connection.
-        self._reader = _ResponseReader()
+        self._reader = _ResponseReader(self._line_limit)
         try:
             self._sock = socket.create_connection(
                 (self.host, self.port), timeout=self.timeout
@@ -294,7 +313,15 @@ class MerkleKVClient:
 
     def _read_line(self) -> str:
         while True:
-            line = self._reader.next_line()
+            try:
+                line = self._reader.next_line()
+            except ProtocolError:
+                # Over-limit line: the rest of the oversized value is
+                # still in flight, so the stream is desynchronized —
+                # close rather than let a caller who catches the error
+                # read value bytes as later responses.
+                self.close()
+                raise
             if line is not None:
                 return line
             try:
@@ -850,10 +877,17 @@ class AsyncMerkleKVClient:
         host: str = "localhost",
         port: int = DEFAULT_PORT,
         timeout: float = 5.0,
+        max_value_bytes: int = 1 << 20,
     ) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
+        # Sizes the StreamReader line limit (plus header slack) at
+        # connect(): readline() raises a bare ValueError on any line past
+        # the limit, so a GET of a value larger than the old fixed 1 MiB
+        # cap used to fail mid-stream. Raise this to round-trip bigger
+        # values; the sync client accepts the same argument for parity.
+        self.max_value_bytes = max_value_bytes
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         # Wire-byte accounting, mirroring the sync client.
@@ -871,10 +905,14 @@ class AsyncMerkleKVClient:
         try:
             # limit: StreamReader.readline defaults to a 64 KiB cap and
             # raises a bare ValueError past it — a SNAPCHUNK payload line
-            # (base64 of up to a 256 KiB raw range) and large MGET value
-            # lines both exceed that legitimately.
+            # (base64 of up to a 256 KiB raw range), large MGET value
+            # lines, and any VALUE line near max_value_bytes all exceed
+            # that legitimately. Sized from max_value_bytes plus header
+            # slack ("VALUE "/"key " prefixes + CRLF), floored at the old
+            # 1 MiB so SNAPCHUNK framing never regresses.
+            limit = max(1 << 20, self.max_value_bytes + (1 << 16))
             self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(self.host, self.port, limit=1 << 20),
+                asyncio.open_connection(self.host, self.port, limit=limit),
                 self.timeout,
             )
         except (OSError, asyncio.TimeoutError) as e:

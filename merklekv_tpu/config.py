@@ -134,6 +134,22 @@ class ServerConfig:
     # default) sizes the pool to hardware concurrency; 1 keeps a single
     # loop. See docs/DEPLOYMENT.md "I/O plane sizing".
     io_threads: int = 0
+    # SO_REUSEPORT accept sharding: "auto" (default) gives every io worker
+    # its own listening socket where the kernel supports it — the kernel
+    # deals connections across workers and the single accept thread stops
+    # being the connection-storm bottleneck; "on" insists (falls back with
+    # a note where unsupported); "off" keeps the single accept loop.
+    # Admission control is enforced identically on both paths.
+    reuseport: str = "auto"
+    # Zero-copy serving (default on): GET/MGET hand the engine's
+    # refcounted value block straight to writev — zero copies after
+    # ingest. false restores the copy-out-of-the-engine compat path
+    # (wire-identical; the bench A/B baseline).
+    zero_copy: bool = True
+    # Request-line byte cap (0 = the 1 MiB default). Size it ABOVE the
+    # largest value a SET may carry plus ~key/verb headroom; see
+    # docs/DEPLOYMENT.md "Large-value serving".
+    max_line_bytes: int = 0
     # Accepted-connection cap: past it, excess accepts are answered
     # "ERROR BUSY connections retry" and closed without ever entering the
     # worker pool. 0 = unlimited.
@@ -425,6 +441,7 @@ class Config:
             "max_pipeline",
             "memory_soft_bytes",
             "memory_hard_bytes",
+            "max_line_bytes",
         ):
             if k in srv:
                 setattr(cfg.server, k, int(srv[k]))
@@ -432,6 +449,20 @@ class Config:
             raise ValueError(
                 "[server] io_threads must be >= 0 (0 = hardware "
                 f"concurrency), got {cfg.server.io_threads}"
+            )
+        if "reuseport" in srv:
+            cfg.server.reuseport = str(srv["reuseport"])
+        if cfg.server.reuseport not in ("auto", "on", "off"):
+            raise ValueError(
+                "[server] reuseport must be auto|on|off, got "
+                f"{cfg.server.reuseport!r}"
+            )
+        if "zero_copy" in srv:
+            cfg.server.zero_copy = bool(srv["zero_copy"])
+        if cfg.server.max_line_bytes < 0:
+            raise ValueError(
+                "[server] max_line_bytes must be >= 0 (0 = the 1 MiB "
+                f"default), got {cfg.server.max_line_bytes}"
             )
         if "recovery_ratio" in srv:
             cfg.server.recovery_ratio = float(srv["recovery_ratio"])

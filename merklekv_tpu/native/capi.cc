@@ -253,6 +253,19 @@ long long mkv_engine_tomb_evictions(void* h) {
   return (long long)static_cast<Engine*>(h)->tomb_evictions();
 }
 
+// Slab-account snapshot: out[0]=live bytes (reader-pinned included),
+// out[1]=blocks, out[2]=pinned bytes (held only by in-flight responses),
+// out[3]=lifetime allocs, out[4]=allocation failures (arena byte limit).
+// Zeros for engines without block storage.
+void mkv_engine_slab_stats(void* h, unsigned long long out[5]) {
+  mkv::SlabStats st = static_cast<Engine*>(h)->slab_stats();
+  out[0] = st.bytes;
+  out[1] = st.blocks;
+  out[2] = st.pinned_bytes;
+  out[3] = st.allocs;
+  out[4] = st.alloc_failures;
+}
+
 // Engine mutation version (bumped per write). For engines that do not
 // track versions the base-class fallback increments per CALL — callers
 // comparing versions across reads (mirror-staleness gauge) should only do
@@ -415,6 +428,33 @@ void mkv_server_configure_io(void* h, long long io_threads, int pipelined) {
 // Resolved worker-pool width (0 before start).
 long long mkv_server_io_threads(void* h) {
   return (long long)static_cast<ServerHandle*>(h)->server->io_threads();
+}
+
+// SO_REUSEPORT accept sharding, set BEFORE mkv_server_start: -1 off
+// (single accept loop), 0 auto (shard where the kernel supports it),
+// 1 on (falls back with a stderr note where unsupported).
+void mkv_server_configure_accept(void* h, int reuseport) {
+  static_cast<ServerHandle*>(h)->server->configure_accept(reuseport);
+}
+
+// 1 once start() actually sharded the accept path (every io worker owns
+// its own listener); 0 before start or on the single-loop fallback.
+int mkv_server_reuseport(void* h) {
+  return static_cast<ServerHandle*>(h)->server->reuseport_active() ? 1 : 0;
+}
+
+// Zero-copy serving A/B toggle (default on): off restores the copy-out-
+// of-the-engine compat path — wire-identical, the bench baseline.
+void mkv_server_set_zero_copy(void* h, int on) {
+  static_cast<ServerHandle*>(h)->server->set_zero_copy(on != 0);
+}
+
+// Request-line byte cap, set BEFORE mkv_server_start (<= 0 keeps the
+// 1 MiB default). A SET of a value near or past 1 MiB needs headroom.
+void mkv_server_set_max_line(void* h, long long bytes) {
+  if (bytes > 0) {
+    static_cast<ServerHandle*>(h)->server->set_max_line(size_t(bytes));
+  }
 }
 
 int mkv_server_start(void* h) {

@@ -15,6 +15,7 @@
 #include <cstring>
 #include <functional>
 #include <mutex>
+#include <new>
 
 #include "merkle.h"
 
@@ -56,17 +57,112 @@ std::string not_a_number(const std::string& key) {
   return "Value for key '" + key + "' is not a valid number";
 }
 
+// Set by ValueBlock::make when the arena byte limit refuses a block; read
+// (and cleared) by consume_slab_exhausted(). Thread-local is exact here:
+// the server dispatches the engine write and inspects the failure on the
+// same thread, so no cross-thread signal is needed.
+thread_local bool t_slab_exhausted = false;
+
 }  // namespace
+
+bool consume_slab_exhausted() {
+  bool v = t_slab_exhausted;
+  t_slab_exhausted = false;
+  return v;
+}
+
+// ----------------------------------------------------- value slab blocks
+
+SlabAccount::SlabAccount() {
+  // Test hook: cap the arena so exhaustion (and the BUSY-memory shed it
+  // feeds) is exercisable without filling real RAM. 0/absent = unlimited.
+  if (const char* env = ::getenv("MKV_MAX_SLAB_BYTES")) {
+    int64_t v;
+    if (parse_i64(env, &v) && v > 0) limit_ = v;
+  }
+}
+
+ValueBlock* ValueBlock::make(std::shared_ptr<SlabAccount> acct,
+                             const char* data, size_t len, size_t credit) {
+  if (len > UINT32_MAX) return nullptr;
+  if (acct && !acct->reserve(len, credit)) {
+    t_slab_exhausted = true;
+    return nullptr;
+  }
+  void* mem = std::malloc(sizeof(ValueBlock) + len);
+  if (!mem) {
+    if (acct) acct->on_free(len);
+    return nullptr;
+  }
+  auto* b = new (mem) ValueBlock(std::move(acct), uint32_t(len));
+  if (len) std::memcpy(const_cast<char*>(b->data()), data, len);
+  return b;
+}
+
+void ValueBlock::unref() {
+  if (rc_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    // Settle the account AFTER the free so live_bytes never under-counts
+    // memory that is still allocated.
+    std::shared_ptr<SlabAccount> acct = std::move(acct_);
+    const size_t len = len_;
+    this->~ValueBlock();
+    std::free(this);
+    if (acct) acct->on_free(len);
+  }
+}
 
 // ------------------------------------------------------------- MemEngine
 
-MemEngine::MemEngine() : max_tombs_(1 << 16) {
+MemEngine::MemEngine()
+    : max_tombs_(1 << 16), slab_(std::make_shared<SlabAccount>()) {
   // Test hook: shrink the per-shard tombstone cap so eviction (and the
   // resurrection defense around it) is exercisable without ~1M deletes.
   if (const char* env = ::getenv("MKV_MAX_TOMBS_PER_SHARD")) {
     int64_t v;
     if (parse_i64(env, &v) && v > 0) max_tombs_ = size_t(v);
   }
+}
+
+BlockRef MemEngine::make_block(const char* data, size_t len, size_t credit) {
+  // Clear any stale latch first so it reflects THIS allocation only: a
+  // path that returns without consuming it (set_if_newer shed) must not
+  // make a later plain-malloc failure read as retryable arena exhaustion.
+  t_slab_exhausted = false;
+  return BlockRef::adopt(ValueBlock::make(slab_, data, len, credit));
+}
+
+size_t MemEngine::live_size(const std::string& key) {
+  Shard& s = shard_for(key);
+  std::shared_lock lk(s.mu);
+  auto it = s.map.find(key);
+  return it == s.map.end() ? 0 : it->second.value.size();
+}
+
+void MemEngine::install_locked(Shard& s, const std::string& key,
+                               BlockRef block, uint64_t ts) {
+  const long long nsz = (long long)block.size();
+  auto it = s.map.find(key);
+  if (it != s.map.end()) {
+    slab_->engine_hold(nsz - (long long)it->second.value.size());
+    it->second.value = std::move(block);  // drops the old engine ref
+    it->second.ts = ts;
+  } else {
+    acct((long long)key.size());
+    slab_->engine_hold(nsz);
+    s.map.emplace(key, Entry{std::move(block), ts});
+  }
+  // A present value supersedes any deletion record: without this a key
+  // would be advertised live AND tombstoned to peers at once.
+  s.tombs.erase(key);
+}
+
+bool MemEngine::erase_locked(Shard& s, const std::string& key) {
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return false;
+  acct(-(long long)key.size());
+  slab_->engine_hold(-(long long)it->second.value.size());
+  s.map.erase(it);  // drops the engine ref; in-flight responses keep theirs
+  return true;
 }
 
 MemEngine::Shard& MemEngine::shard_for(const std::string& key) {
@@ -78,6 +174,17 @@ std::optional<std::string> MemEngine::get(const std::string& key) {
   std::shared_lock lk(s.mu);
   auto it = s.map.find(key);
   if (it == s.map.end()) return std::nullopt;
+  return it->second.value.str();
+}
+
+BlockRef MemEngine::get_block(const std::string& key) {
+  Shard& s = shard_for(key);
+  std::shared_lock lk(s.mu);
+  auto it = s.map.find(key);
+  if (it == s.map.end()) return {};
+  // Copying the handle takes a ref UNDER the shard lock, which is what
+  // makes the block's lifetime safe once the lock drops: a concurrent
+  // DEL/overwrite only drops the engine's ref, never this one.
   return it->second.value;
 }
 
@@ -87,19 +194,23 @@ bool MemEngine::set(const std::string& key, const std::string& value) {
 
 bool MemEngine::set_with_ts(const std::string& key, const std::string& value,
                             uint64_t ts) {
+  // The ingest copy — the ONE copy a value ever pays — happens here,
+  // outside the shard lock (the old string path copied while holding it).
+  // The overwrite credit (old value's size, read under a shared lock) is
+  // advisory — a racing overwrite of the same key can at worst admit one
+  // extra value past the cap — but without it an overwrite near the
+  // arena limit is refused with a retryable BUSY no retry can satisfy.
+  // A null block NEVER installs (empty values get a real header-only
+  // block; reserve always admits len 0): an entry with a null ref would
+  // exist for get()/EXISTS yet serve NOT_FOUND through get_block().
+  // An unlimited arena (the production default) ignores the credit, so
+  // skip the extra shard lookup on the hot write path.
+  BlockRef block =
+      make_block(value, slab_->limit() > 0 ? live_size(key) : 0);
+  if (!block) return false;  // arena exhausted (or malloc refused)
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  auto it = s.map.find(key);
-  if (it != s.map.end()) {
-    acct((long long)value.size() - (long long)it->second.value.size());
-    it->second = Entry{value, ts};
-  } else {
-    acct((long long)(key.size() + value.size()));
-    s.map.emplace(key, Entry{value, ts});
-  }
-  // A present value supersedes any deletion record: without this a key
-  // would be advertised live AND tombstoned to peers at once.
-  s.tombs.erase(key);
+  install_locked(s, key, std::move(block), ts);
   bump_version();
   return true;
 }
@@ -118,7 +229,7 @@ std::optional<std::pair<std::string, uint64_t>> MemEngine::get_with_ts(
   std::shared_lock lk(s.mu);
   auto it = s.map.find(key);
   if (it == s.map.end()) return std::nullopt;
-  return std::make_pair(it->second.value, it->second.ts);
+  return std::make_pair(it->second.value.str(), it->second.ts);
 }
 
 bool MemEngine::note_tomb(Shard& s, const std::string& key, uint64_t ts) {
@@ -183,12 +294,7 @@ bool MemEngine::del_with_ts_report(const std::string& key, uint64_t ts,
                                    bool* advanced) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  auto it = s.map.find(key);
-  bool existed = it != s.map.end();
-  if (existed) {
-    acct(-(long long)(key.size() + it->second.value.size()));
-    s.map.erase(it);
-  }
+  bool existed = erase_locked(s, key);
   bool tomb_advanced = note_tomb(s, key, ts);
   *advanced = existed || tomb_advanced;
   if (*advanced) bump_version();
@@ -198,13 +304,8 @@ bool MemEngine::del_with_ts_report(const std::string& key, uint64_t ts,
 bool MemEngine::del_quiet(const std::string& key) {
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
-  auto it = s.map.find(key);
-  bool existed = it != s.map.end();
-  if (existed) {
-    acct(-(long long)(key.size() + it->second.value.size()));
-    s.map.erase(it);
-    bump_version();
-  }
+  bool existed = erase_locked(s, key);
+  if (existed) bump_version();
   return existed;
 }
 
@@ -220,14 +321,14 @@ bool MemEngine::set_if_newer_locked(Shard& s, const std::string& key,
   auto it = s.map.find(key);
   if (it != s.map.end()) {
     if (ts < it->second.ts) return false;
-    if (ts == it->second.ts && value != it->second.value) {
+    if (ts == it->second.ts && it->second.value.view() != value) {
       // Exact-ts cross-writer conflict: break deterministically by leaf
       // digest (larger wins), the same (ts, liveness, digest) order the
       // multi-peer sync arbitration uses. Replicas applying equal-ts
       // events in any order therefore converge on the max-digest value
       // through replication alone — no sync loop required.
       uint8_t cur[32], neu[32];
-      leaf_hash(key, it->second.value, cur);
+      leaf_hash(key, it->second.value.str(), cur);
       leaf_hash(key, value, neu);
       if (::memcmp(neu, cur, 32) < 0) return false;
     }
@@ -246,14 +347,15 @@ bool MemEngine::set_if_newer_locked(Shard& s, const std::string& key,
     // deletion-stability — it would only pin the stale value.
     return false;
   }
-  if (it != s.map.end()) {
-    acct((long long)value.size() - (long long)it->second.value.size());
-    it->second = Entry{value, ts};
-  } else {
-    acct((long long)(key.size() + value.size()));
-    s.map.emplace(key, Entry{value, ts});
-  }
-  if (tt != s.tombs.end()) s.tombs.erase(tt);
+  // LWW checks passed: materialize the block (under the lock — this is
+  // the replication/repair path, not the GET hot path) and install. The
+  // replaced value's size credits the arena check (exact here: the lock
+  // is held from lookup through install).
+  BlockRef block = make_block(
+      value, it == s.map.end() ? 0 : it->second.value.size());
+  if (!block) return false;  // arena exhausted (or malloc): shed, never
+                             // install a null ref (see set_with_ts)
+  install_locked(s, key, std::move(block), ts);
   bump_version();
   return true;
 }
@@ -269,8 +371,7 @@ bool MemEngine::del_if_newer_locked(Shard& s, const std::string& key,
   auto it = s.map.find(key);
   if (it != s.map.end()) {
     if (ts <= it->second.ts) return false;  // tie: value wins
-    acct(-(long long)(key.size() + it->second.value.size()));
-    s.map.erase(it);
+    erase_locked(s, key);
     note_tomb(s, key, ts);
     bump_version();
     return true;
@@ -448,12 +549,25 @@ size_t MemEngine::dbsize() {
 }
 
 size_t MemEngine::memory_usage() {
-  // O(1): the incremental byte counter maintained at every map mutation
-  // under the shard locks. Approximate by design (string capacity, map
-  // overhead, and tombstones are not counted) — it is the watermark
-  // signal for the overload monitor, not an allocator report.
+  // O(1): incremental key bytes + the slab account's live value bytes.
+  // The slab number INCLUDES blocks whose only remaining refs are
+  // in-flight responses (a slow reader's parked writev), so the PR 8
+  // memory watermarks see reader-pinned memory and shed before the
+  // allocator, not after. Approximate by design (map overhead and
+  // tombstones are not counted) — it is the watermark signal for the
+  // overload monitor, not an allocator report.
   long long n = approx_bytes_.load(std::memory_order_relaxed);
-  return n > 0 ? size_t(n) : 0;
+  return (n > 0 ? size_t(n) : 0) + size_t(slab_->live_bytes());
+}
+
+SlabStats MemEngine::slab_stats() {
+  SlabStats st;
+  st.bytes = slab_->live_bytes();
+  st.blocks = slab_->blocks();
+  st.pinned_bytes = slab_->pinned_bytes();
+  st.allocs = slab_->allocs();
+  st.alloc_failures = slab_->alloc_failures();
+  return st;
 }
 
 Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
@@ -461,20 +575,22 @@ Result<int64_t> MemEngine::add(const std::string& key, int64_t delta) {
   std::unique_lock lk(s.mu);
   int64_t cur = 0;
   auto it = s.map.find(key);
-  if (it != s.map.end() && !parse_i64(it->second.value, &cur)) {
+  if (it != s.map.end() && !parse_i64(it->second.value.str(), &cur)) {
     return Result<int64_t>::Err(not_a_number(key));
   }
   // Wrapping add (reference release-mode semantics).
   int64_t next = int64_t(uint64_t(cur) + uint64_t(delta));
   std::string text = std::to_string(next);
-  if (it != s.map.end()) {
-    acct((long long)text.size() - (long long)it->second.value.size());
-    it->second = Entry{std::move(text), now_ns()};
-  } else {
-    acct((long long)(key.size() + text.size()));
-    s.map.emplace(key, Entry{std::move(text), now_ns()});
+  BlockRef block = make_block(
+      text, it == s.map.end() ? 0 : it->second.value.size());
+  if (!block) {
+    // Only a refusal by the arena limit earns the retryable typed error;
+    // a plain malloc failure must not tell the client to retry forever.
+    return Result<int64_t>::Err(consume_slab_exhausted()
+                                    ? kSlabExhaustedError
+                                    : "allocation failed");
   }
-  s.tombs.erase(key);  // live entry supersedes any deletion record
+  install_locked(s, key, std::move(block), now_ns());
   bump_version();
   return Result<int64_t>::Ok(next);
 }
@@ -492,22 +608,33 @@ Result<std::string> MemEngine::splice(const std::string& key,
   Shard& s = shard_for(key);
   std::unique_lock lk(s.mu);
   auto it = s.map.find(key);
+  // Build `next` straight from the old block's view — no str() temporary:
+  // a few-byte APPEND to a 1 MiB value must not materialize (and then
+  // re-copy) the old value while holding the shard's unique lock.
   std::string next;
   if (it == s.map.end()) {
     next = value;
-  } else if (append) {
-    next = it->second.value + value;
   } else {
-    next = value + it->second.value;
+    std::string_view old = it->second.value.view();
+    next.reserve(old.size() + value.size());
+    if (append) {
+      next.append(old.data(), old.size());
+      next.append(value);
+    } else {
+      next.append(value);
+      next.append(old.data(), old.size());
+    }
   }
-  if (it != s.map.end()) {
-    acct((long long)next.size() - (long long)it->second.value.size());
-    it->second = Entry{next, now_ns()};
-  } else {
-    acct((long long)(key.size() + next.size()));
-    s.map.emplace(key, Entry{next, now_ns()});
+  BlockRef block = make_block(
+      next, it == s.map.end() ? 0 : it->second.value.size());
+  if (!block) {
+    // See add(): retryable only when the arena limit (not malloc) refused.
+    // A null block never installs (see set_with_ts).
+    return Result<std::string>::Err(consume_slab_exhausted()
+                                        ? kSlabExhaustedError
+                                        : "allocation failed");
   }
-  s.tombs.erase(key);  // live entry supersedes any deletion record
+  install_locked(s, key, std::move(block), now_ns());
   bump_version();
   return Result<std::string>::Ok(next);
 }
@@ -526,7 +653,8 @@ bool MemEngine::truncate() {
   for (Shard& s : shards_) {
     std::unique_lock lk(s.mu);
     for (const auto& [k, e] : s.map) {
-      acct(-(long long)(k.size() + e.value.size()));
+      acct(-(long long)k.size());
+      slab_->engine_hold(-(long long)e.value.size());
     }
     s.map.clear();
     // TRUNCATE is a local admin wipe, not a per-key deletion: it stays
@@ -542,7 +670,7 @@ std::vector<std::pair<std::string, std::string>> MemEngine::snapshot() {
   std::vector<std::pair<std::string, std::string>> out;
   for (Shard& s : shards_) {
     std::shared_lock lk(s.mu);
-    for (const auto& [k, e] : s.map) out.emplace_back(k, e.value);
+    for (const auto& [k, e] : s.map) out.emplace_back(k, e.value.str());
   }
   std::sort(out.begin(), out.end(),
             [](const auto& a, const auto& b) { return a.first < b.first; });

@@ -18,11 +18,197 @@
 #include <optional>
 #include <shared_mutex>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <utility>
 #include <vector>
 
 namespace mkv {
+
+// Error text for a write refused by slab-arena exhaustion. The server's
+// dispatch matches it to answer the PR 8-shaped "ERROR BUSY memory retry"
+// (shed the write, never abort) instead of a generic failure.
+inline constexpr char kSlabExhaustedError[] = "slab arena exhausted";
+
+// ------------------------------------------------------ value slab blocks
+//
+// A value is materialized ONCE at ingest into a single contiguous
+// allocation (block header + payload — "slab-allocated") and shared by
+// atomic refcount from then on: the engine holds one ref per live entry,
+// and every in-flight response (OutQueue iovec segment) holds its own, so
+// a hot GET serves with ZERO copies after ingest and a DEL/overwrite can
+// never free bytes a slow reader's writev still needs.
+
+// Per-engine slab accounting, shared (via shared_ptr) by the engine and
+// every block it ever allocated — a block pinned only by an in-flight
+// OutQueue keeps the account alive and keeps COUNTING, which is what lets
+// memory_usage() include reader-pinned bytes so the PR 8 memory
+// watermarks stay honest.
+class SlabAccount {
+ public:
+  SlabAccount();  // reads MKV_MAX_SLAB_BYTES (test hook; 0 = unlimited)
+
+  // Reserve `len` payload bytes for a new block. False when the arena
+  // byte limit refuses the allocation (counted; the caller sheds).
+  // `credit` is the payload size of a live value this block will REPLACE:
+  // the limit check admits the write as if those bytes were already
+  // freed — an overwrite/APPEND near the cap must not be refused with a
+  // retryable BUSY that no retry can ever satisfy (the old value only
+  // leaves the account when the new one installs). The account itself is
+  // not debited here (the old block frees when its last ref drops), so
+  // live_bytes may transiently exceed the limit by up to `credit`; the
+  // cap is a shedding watermark, not a hard allocator bound.
+  bool reserve(size_t len, size_t credit = 0) {
+    // len == 0 always admits: an empty value occupies no payload bytes,
+    // and refusing it (possible when credit-admitted overwrites have
+    // live_bytes transiently over the cap) would shed a write that frees
+    // more than it takes.
+    if (limit_ > 0 && len > 0) {
+      long long need = (long long)len - (long long)credit;
+      long long cur = live_bytes_.load(std::memory_order_relaxed);
+      do {
+        if (cur + need > limit_) {
+          alloc_failures_.fetch_add(1, std::memory_order_relaxed);
+          return false;
+        }
+      } while (!live_bytes_.compare_exchange_weak(
+          cur, cur + (long long)len, std::memory_order_relaxed));
+    } else {
+      live_bytes_.fetch_add((long long)len, std::memory_order_relaxed);
+    }
+    blocks_.fetch_add(1, std::memory_order_relaxed);
+    allocs_.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+  void on_free(size_t len) {
+    live_bytes_.fetch_sub((long long)len, std::memory_order_relaxed);
+    blocks_.fetch_sub(1, std::memory_order_relaxed);
+  }
+  // Engine-held share (bytes referenced from the live map), adjusted by
+  // the engine under its shard locks; live - engine = bytes NOT held by
+  // the live map: in-flight responses plus values mid-ingest (reserved
+  // but not yet installed) plus replaced values whose reader refs are
+  // still draining.
+  void engine_hold(long long delta) {
+    engine_bytes_.fetch_add(delta, std::memory_order_relaxed);
+  }
+
+  uint64_t live_bytes() const {
+    long long v = live_bytes_.load(std::memory_order_relaxed);
+    return v > 0 ? uint64_t(v) : 0;
+  }
+  uint64_t blocks() const {
+    long long v = blocks_.load(std::memory_order_relaxed);
+    return v > 0 ? uint64_t(v) : 0;
+  }
+  uint64_t pinned_bytes() const {
+    long long live = live_bytes_.load(std::memory_order_relaxed);
+    long long eng = engine_bytes_.load(std::memory_order_relaxed);
+    return live > eng ? uint64_t(live - eng) : 0;
+  }
+  uint64_t allocs() const {
+    return allocs_.load(std::memory_order_relaxed);
+  }
+  uint64_t alloc_failures() const {
+    return alloc_failures_.load(std::memory_order_relaxed);
+  }
+  long long limit() const { return limit_; }
+
+ private:
+  std::atomic<long long> live_bytes_{0};    // all live blocks' payload bytes
+  std::atomic<long long> engine_bytes_{0};  // subset held by the live map
+  std::atomic<long long> blocks_{0};
+  std::atomic<uint64_t> allocs_{0};
+  std::atomic<uint64_t> alloc_failures_{0};
+  long long limit_ = 0;  // MKV_MAX_SLAB_BYTES; 0 = unlimited
+};
+
+// True exactly once after the calling thread's last failed write was
+// refused by slab-arena exhaustion (ValueBlock::make sets it, this read
+// clears it). Dispatch runs engine writes on the same thread, so the flag
+// lets the server answer the PR 8-shaped "ERROR BUSY memory retry"
+// instead of a generic failure without changing every write signature.
+bool consume_slab_exhausted();
+
+// Point-in-time slab accounting snapshot (STATS / exporter bridge).
+struct SlabStats {
+  uint64_t bytes = 0;         // live payload bytes, reader-pinned included
+  uint64_t blocks = 0;        // live blocks
+  uint64_t pinned_bytes = 0;  // bytes not held by the live map: in-flight
+                              // responses + values mid-ingest/mid-replace
+  uint64_t allocs = 0;        // lifetime block allocations
+  uint64_t alloc_failures = 0;  // writes refused by the arena byte limit
+};
+
+// Immutable refcounted value block: header + payload in ONE allocation.
+// Never constructed directly — make() allocates, unref() at zero frees
+// and settles the account.
+class ValueBlock {
+ public:
+  // nullptr when the account's byte limit (or malloc) refuses — a typed
+  // exhaustion the write path sheds, never an abort. `credit` = payload
+  // size of the live value this block replaces (see SlabAccount::reserve).
+  static ValueBlock* make(std::shared_ptr<SlabAccount> acct,
+                          const char* data, size_t len, size_t credit = 0);
+
+  const char* data() const {
+    return reinterpret_cast<const char*>(this) + sizeof(ValueBlock);
+  }
+  size_t size() const { return len_; }
+  std::string_view view() const { return {data(), len_}; }
+  void ref() { rc_.fetch_add(1, std::memory_order_relaxed); }
+  void unref();
+
+ private:
+  ValueBlock(std::shared_ptr<SlabAccount> acct, uint32_t len)
+      : rc_(1), len_(len), acct_(std::move(acct)) {}
+  ~ValueBlock() = default;
+
+  std::atomic<uint32_t> rc_;
+  uint32_t len_;
+  std::shared_ptr<SlabAccount> acct_;
+};
+
+// RAII handle: copying takes a ref, destruction drops one. This is what
+// the engine stores per entry and what rides the OutQueue until writev
+// completes.
+class BlockRef {
+ public:
+  BlockRef() = default;
+  // Adopts an already-counted ref (ValueBlock::make returns rc == 1).
+  static BlockRef adopt(ValueBlock* b) {
+    BlockRef r;
+    r.b_ = b;
+    return r;
+  }
+  BlockRef(const BlockRef& o) : b_(o.b_) {
+    if (b_) b_->ref();
+  }
+  BlockRef(BlockRef&& o) noexcept : b_(o.b_) { o.b_ = nullptr; }
+  BlockRef& operator=(BlockRef o) noexcept {
+    std::swap(b_, o.b_);
+    return *this;
+  }
+  ~BlockRef() {
+    if (b_) b_->unref();
+  }
+  explicit operator bool() const { return b_ != nullptr; }
+  const char* data() const { return b_ ? b_->data() : ""; }
+  size_t size() const { return b_ ? b_->size() : 0; }
+  std::string_view view() const {
+    return b_ ? b_->view() : std::string_view{};
+  }
+  std::string str() const { return std::string(view()); }
+  void reset() {
+    if (b_) {
+      b_->unref();
+      b_ = nullptr;
+    }
+  }
+
+ private:
+  ValueBlock* b_ = nullptr;
+};
 
 template <typename T>
 struct Result {
@@ -48,6 +234,15 @@ class Engine {
   virtual ~Engine() = default;
 
   virtual std::optional<std::string> get(const std::string& key) = 0;
+  // Zero-copy read: a ref on the value's immutable block, acquired under
+  // the shard lock, handed straight to the I/O plane as an iovec segment.
+  // The base fallback materializes an unaccounted copy so engines without
+  // block storage keep the same surface.
+  virtual BlockRef get_block(const std::string& key) {
+    auto v = get(key);
+    if (!v) return {};
+    return BlockRef::adopt(ValueBlock::make(nullptr, v->data(), v->size()));
+  }
   virtual bool set(const std::string& key, const std::string& value) = 0;
   // Install a value with an explicit last-write timestamp (unix ns).
   // Used by LWW repair paths (anti-entropy, replication apply) so ordering
@@ -162,6 +357,8 @@ class Engine {
   // by a stale replica; this counter makes that silent degradation visible
   // (surfaced via STATS as tombstone_evictions).
   virtual uint64_t tomb_evictions() { return 0; }
+  // Slab accounting snapshot; zeros for engines without block storage.
+  virtual SlabStats slab_stats() { return {}; }
 
  private:
   std::atomic<uint64_t> fallback_version_{0};
@@ -175,6 +372,9 @@ class MemEngine : public Engine {
   MemEngine();
 
   std::optional<std::string> get(const std::string& key) override;
+  // The zero-copy read: one shared-lock acquire, one atomic ref bump —
+  // the block itself is the response bytes from here to writev.
+  BlockRef get_block(const std::string& key) override;
   bool set(const std::string& key, const std::string& value) override;
   bool set_with_ts(const std::string& key, const std::string& value,
                    uint64_t ts) override;
@@ -217,13 +417,14 @@ class MemEngine : public Engine {
   uint64_t tomb_evictions() override {
     return tomb_evictions_.load(std::memory_order_relaxed);
   }
+  SlabStats slab_stats() override;
   uint64_t version() override {
     return version_.load(std::memory_order_acquire);
   }
 
  private:
   struct Entry {
-    std::string value;
+    BlockRef value;   // engine's ref on the immutable slab block
     uint64_t ts = 0;  // last-write unix ns
   };
   struct Shard {
@@ -268,6 +469,25 @@ class MemEngine : public Engine {
   Result<int64_t> add(const std::string& key, int64_t delta);
   Result<std::string> splice(const std::string& key, const std::string& value,
                              bool append);
+  // Materialize a value into an accounted slab block; empty on arena
+  // exhaustion (the thread-local exhaustion flag is set for the caller).
+  // `credit` = size of the live value being replaced, so an overwrite
+  // near the arena cap is admitted (see SlabAccount::reserve).
+  BlockRef make_block(const char* data, size_t len, size_t credit = 0);
+  BlockRef make_block(const std::string& v, size_t credit = 0) {
+    return make_block(v.data(), v.size(), credit);
+  }
+  // Payload size of `key`'s live value (0 when absent) — the overwrite
+  // credit for a write path that allocates BEFORE taking the unique lock.
+  size_t live_size(const std::string& key);
+  // Install `block` as the live entry for `key` in shard `s` (caller holds
+  // the unique lock): settles the engine-held byte share for both the old
+  // and new value and erases any tombstone.
+  void install_locked(Shard& s, const std::string& key, BlockRef block,
+                      uint64_t ts);
+  // Remove the live entry if present (caller holds the unique lock),
+  // settling accounting; returns whether it existed.
+  bool erase_locked(Shard& s, const std::string& key);
 
   Shard shards_[kShards];
   // Default 1<<16; MKV_MAX_TOMBS_PER_SHARD overrides (tests shrink it to
@@ -275,7 +495,12 @@ class MemEngine : public Engine {
   size_t max_tombs_;
   std::atomic<uint64_t> tomb_evictions_{0};
   std::atomic<uint64_t> version_{1};
+  // Key bytes only: value bytes live in the slab account (which keeps
+  // counting blocks pinned by in-flight responses after the engine drops
+  // its ref — memory_usage() = keys + slab live bytes, so the PR 8
+  // memory watermarks see reader-pinned memory too).
   std::atomic<long long> approx_bytes_{0};
+  std::shared_ptr<SlabAccount> slab_;
 };
 
 // Durable engine: MemEngine semantics + append-only operation log
@@ -289,6 +514,9 @@ class LogEngine : public Engine {
   ~LogEngine() override;
 
   std::optional<std::string> get(const std::string& key) override;
+  BlockRef get_block(const std::string& key) override {
+    return mem_.get_block(key);
+  }
   bool set(const std::string& key, const std::string& value) override;
   bool set_with_ts(const std::string& key, const std::string& value,
                    uint64_t ts) override;
@@ -327,6 +555,7 @@ class LogEngine : public Engine {
   bool sync() override;
   std::vector<std::pair<std::string, std::string>> snapshot() override;
   uint64_t tomb_evictions() override { return mem_.tomb_evictions(); }
+  SlabStats slab_stats() override { return mem_.slab_stats(); }
 
   // Rewrite the log as a snapshot of current state — live entries AND
   // tombstones (dropping deletion records would let older writes resurrect

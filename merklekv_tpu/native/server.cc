@@ -152,6 +152,11 @@ class IoWorker {
     release();
   }
 
+  // Accept sharding: hand this worker its own SO_REUSEPORT listening
+  // socket (nonblocking) BEFORE start(); the worker accepts directly in
+  // its event loop — no accept-thread hop, no inbox round trip.
+  void set_listen(int fd) { listen_fd_ = fd; }
+
   bool start() {
     epfd_ = ::epoll_create1(EPOLL_CLOEXEC);
     if (epfd_ < 0) return false;
@@ -167,6 +172,12 @@ class IoWorker {
     ev.events = EPOLLIN;
     ev.data.fd = wake_r_;
     ::epoll_ctl(epfd_, EPOLL_CTL_ADD, wake_r_, &ev);
+    if (listen_fd_ >= 0) {
+      epoll_event lv{};
+      lv.events = EPOLLIN;
+      lv.data.fd = listen_fd_;
+      ::epoll_ctl(epfd_, EPOLL_CTL_ADD, listen_fd_, &lv);
+    }
     th_ = std::thread([this] { loop(); });
     return true;
   }
@@ -207,10 +218,11 @@ class IoWorker {
     std::lock_guard lk(inbox_mu_);
     for (auto& p : inbox_) drop_pending(p);
     inbox_.clear();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
     if (wake_r_ >= 0) ::close(wake_r_);
     if (wake_w_ >= 0) ::close(wake_w_);
     if (epfd_ >= 0) ::close(epfd_);
-    wake_r_ = wake_w_ = epfd_ = -1;
+    listen_fd_ = wake_r_ = wake_w_ = epfd_ = -1;
   }
 
  private:
@@ -263,6 +275,10 @@ class IoWorker {
           woken = true;
           continue;
         }
+        if (fd == listen_fd_) {
+          accept_shard();
+          continue;
+        }
         auto it = conns_.find(fd);
         if (it == conns_.end()) continue;
         Conn& c = *it->second;
@@ -276,6 +292,42 @@ class IoWorker {
       }
       if (woken) adopt_inbox();
       if (srv_->stop_.load(std::memory_order_acquire)) break;
+    }
+  }
+
+  // Drain this worker's own reuseport listener: accept until EAGAIN, run
+  // the SHARED admission control, and install admitted connections
+  // directly into this loop — the connection never crosses a thread.
+  void accept_shard() {
+    for (;;) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int fd = ::accept(listen_fd_,
+                        reinterpret_cast<sockaddr*>(&peer), &plen);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        break;  // EAGAIN (drained) or listener gone
+      }
+      if (srv_->stop_.load(std::memory_order_acquire)) {
+        ::close(fd);
+        break;
+      }
+      if (srv_->refuse_admission(fd)) continue;
+      auto meta = srv_->register_conn(fd, peer);
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      if (::epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev) != 0) {
+        Pending p{fd, std::move(meta)};
+        drop_pending(p);
+        continue;
+      }
+      auto c = std::make_unique<Conn>();
+      c->fd = fd;
+      c->meta = std::move(meta);
+      ws_.connections.fetch_add(1, std::memory_order_relaxed);
+      ws_.accepts.fetch_add(1, std::memory_order_relaxed);
+      conns_[fd] = std::move(c);
     }
   }
 
@@ -501,7 +553,7 @@ class IoWorker {
       size_t n = 0;
       size_t off = c.out.head_off;
       for (size_t i = c.out.head; i < c.out.segs.size() && n < kMaxIov; ++i) {
-        const std::string& s = c.out.segs[i];
+        const OutQueue::Seg& s = c.out.segs[i];
         if (off >= s.size()) {
           off = 0;
           continue;
@@ -528,10 +580,16 @@ class IoWorker {
       size_t rem = size_t(w);
       c.out.bytes -= rem;
       while (rem > 0) {
-        std::string& s = c.out.segs[c.out.head];
+        OutQueue::Seg& s = c.out.segs[c.out.head];
         const size_t avail = s.size() - c.out.head_off;
         if (rem >= avail) {
           rem -= avail;
+          // Segment fully on the wire: release its bytes NOW — for a
+          // block segment that drops the response's pin on the value the
+          // moment the kernel has it, not at end-of-burst.
+          s.str.clear();
+          s.str.shrink_to_fit();
+          s.block.reset();
           ++c.out.head;
           c.out.head_off = 0;
         } else {
@@ -549,6 +607,7 @@ class IoWorker {
   int epfd_ = -1;
   int wake_r_ = -1;
   int wake_w_ = -1;
+  int listen_fd_ = -1;  // this worker's reuseport listener (-1 = none)
   std::thread th_;
   std::mutex inbox_mu_;
   std::vector<Pending> inbox_;
@@ -565,11 +624,47 @@ Server::~Server() {
   wait();
 }
 
+namespace {
+
+// One extra SO_REUSEPORT listener on the already-bound address (the
+// kernel load-balances accepts across every listener on the tuple).
+// Nonblocking: the owning worker accepts from its epoll loop.
+int make_reuseport_listener(const sockaddr_in& addr) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (::setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one)) != 0 ||
+      ::bind(fd, reinterpret_cast<const sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+      ::listen(fd, 1024) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  ::fcntl(fd, F_SETFL, ::fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  return fd;
+}
+
+}  // namespace
+
 bool Server::start() {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return false;
   int one = 1;
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // Accept sharding wants SO_REUSEPORT on the PRIMARY socket too (later
+  // binds to the tuple are refused otherwise). auto (0) degrades silently
+  // where the kernel lacks it; on (1) degrades with a note; off (-1)
+  // never asks.
+  bool rp = false;
+  if (opts_.reuseport >= 0) {
+    rp = ::setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one)) == 0;
+    if (!rp && opts_.reuseport > 0) {
+      std::fprintf(stderr,
+                   "merklekv: reuseport=on but SO_REUSEPORT unsupported; "
+                   "falling back to the single accept loop\n");
+    }
+  }
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(opts_.port);
@@ -597,14 +692,44 @@ bool Server::start() {
   }
   if (n > 64) n = 64;  // sanity cap; nothing here scales past that
   worker_stats_.reset(new IoWorkerStats[n]);
+  // Shard the accept path: each worker gets its own listener on the
+  // bound tuple (ephemeral port 0 resolved above, so every shard binds
+  // the same real port). A shard that fails to bind just leaves that
+  // worker on the handoff path; sharding counts as live only when EVERY
+  // worker got one — a half-sharded pool would skew the kernel's deal.
+  size_t shards = 0;
+  std::vector<int> shard_fds(n, -1);
+  if (rp && n > 0) {
+    sockaddr_in saddr = addr;
+    saddr.sin_port = htons(bound_port_);
+    for (size_t i = 0; i < n; ++i) {
+      shard_fds[i] = make_reuseport_listener(saddr);
+      if (shard_fds[i] >= 0) ++shards;
+    }
+    if (shards != n) {
+      for (int& sfd : shard_fds) {
+        if (sfd >= 0) ::close(sfd);
+        sfd = -1;
+      }
+      shards = 0;
+    }
+  }
+  reuseport_live_ = shards == n && shards > 0 && rp;
   for (size_t i = 0; i < n; ++i) {
     auto w = std::make_unique<IoWorker>(this, i);
+    if (reuseport_live_) w->set_listen(shard_fds[i]);
     if (!w->start()) {
       stop_.store(true, std::memory_order_release);
       for (auto& live : workers_) live->wake();
+      w.reset();         // releases this worker's shard listener too
       workers_.clear();  // ~IoWorker joins + releases
+      // Shard listeners not yet handed to a worker.
+      for (size_t j = i + 1; j < n; ++j) {
+        if (shard_fds[j] >= 0) ::close(shard_fds[j]);
+      }
       stop_.store(false, std::memory_order_release);
       worker_stats_.reset();
+      reuseport_live_ = false;
       ::close(fd);
       return false;
     }
@@ -678,6 +803,62 @@ void Server::set_cluster_callback(ClusterCallback cb) {
   cluster_cb_ = std::move(cb);
 }
 
+bool Server::refuse_admission(int fd) {
+  // Admission control: past max_connections (or while draining) the
+  // excess accept is answered BUSY and closed RIGHT HERE — it never
+  // enters the worker pool, holds no request state. The answer goes out
+  // within one RTT of the connect, and established connections never see
+  // the flood: their worker loops keep turning. The count is the SHARED
+  // active_connections atomic, CLAIMED here (not in register_conn) as a
+  // fetch_add with roll-back: N workers accepting concurrently on their
+  // reuseport listeners would otherwise all pass a plain load-compare at
+  // maxc-1 and overshoot the cap by up to N-1 — the claim keeps the
+  // limit exact on both accept paths.
+  const bool draining =
+      degradation_.load(std::memory_order_acquire) >=
+      int(Degradation::kDraining);
+  bool refuse = draining;
+  if (!refuse) {
+    const size_t maxc = max_connections_.load(std::memory_order_acquire);
+    const uint64_t prev =
+        stats_.active_connections.fetch_add(1, std::memory_order_relaxed);
+    if (maxc > 0 && prev >= maxc) {
+      stats_.active_connections.fetch_sub(1, std::memory_order_relaxed);
+      refuse = true;
+    }
+  }
+  if (!refuse) return false;
+  stats_.busy_rejected_connections.fetch_add(1, std::memory_order_relaxed);
+  send_all(fd, draining ? "ERROR BUSY draining\r\n"
+                        : "ERROR BUSY connections retry\r\n");
+  ::close(fd);
+  return true;
+}
+
+std::shared_ptr<ClientMeta> Server::register_conn(int fd,
+                                                  const sockaddr_in& peer) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  char ip[INET_ADDRSTRLEN] = "?";
+  ::inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+  auto meta = std::make_shared<ClientMeta>();
+  meta->id = next_client_id_.fetch_add(1);
+  meta->addr = std::string(ip) + ":" + std::to_string(ntohs(peer.sin_port));
+  meta->connected_unix = unix_now();
+  meta->last_cmd_unix.store(meta->connected_unix);
+  meta->fd = fd;
+  {
+    std::lock_guard lk(clients_mu_);
+    clients_[meta->id] = meta;
+  }
+  stats_.total_connections++;
+  // active_connections was already claimed by refuse_admission (the
+  // claim IS the admission decision); every teardown path decrements it
+  // exactly once via drop_pending/deregister.
+  ::fcntl(fd, F_SETFL, ::fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  return meta;
+}
+
 void Server::accept_loop() {
   const int lfd = listen_fd_.load(std::memory_order_acquire);
   for (;;) {
@@ -693,49 +874,13 @@ void Server::accept_loop() {
       ::close(fd);
       break;
     }
-    int one = 1;
-    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-
-    // Admission control: past max_connections (or while draining) the
-    // excess accept is answered BUSY and closed RIGHT HERE — it never
-    // enters the worker pool, holds no request state. The answer goes
-    // out within one RTT of the connect (the reply rides the accept
-    // loop), and established connections never see the flood: their
-    // worker loops keep turning.
-    const size_t maxc = max_connections_.load(std::memory_order_acquire);
-    const bool draining =
-        degradation_.load(std::memory_order_acquire) >=
-        int(Degradation::kDraining);
-    if (draining ||
-        (maxc > 0 &&
-         stats_.active_connections.load(std::memory_order_relaxed) >= maxc)) {
-      stats_.busy_rejected_connections.fetch_add(1,
-                                                 std::memory_order_relaxed);
-      send_all(fd, draining
-                       ? "ERROR BUSY draining\r\n"
-                       : "ERROR BUSY connections retry\r\n");
-      ::close(fd);
-      continue;
-    }
-
-    char ip[INET_ADDRSTRLEN] = "?";
-    ::inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
-    auto meta = std::make_shared<ClientMeta>();
-    meta->id = next_client_id_.fetch_add(1);
-    meta->addr = std::string(ip) + ":" + std::to_string(ntohs(peer.sin_port));
-    meta->connected_unix = unix_now();
-    meta->last_cmd_unix.store(meta->connected_unix);
-    meta->fd = fd;
-    {
-      std::lock_guard lk(clients_mu_);
-      clients_[meta->id] = meta;
-    }
-    stats_.total_connections++;
-    stats_.active_connections++;
+    if (refuse_admission(fd)) continue;
     // Round-robin handoff: the worker owns the fd from here (stop() after
     // this point still reaches it — via the clients_ shutdown poke AND the
-    // worker's own stop_-checked inbox/teardown paths).
-    ::fcntl(fd, F_SETFL, ::fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    // worker's own stop_-checked inbox/teardown paths). With accept
+    // sharding live this loop still serves the primary listener's share
+    // of the kernel's deal.
+    auto meta = register_conn(fd, peer);
     const size_t w =
         next_worker_.fetch_add(1, std::memory_order_relaxed) % workers_live_;
     workers_[w]->submit(fd, std::move(meta));
@@ -771,11 +916,25 @@ std::string Server::stats_text() {
   add("pipeline_rejected", ld(stats_.pipeline_rejected));
   add("shed_commands", ld(stats_.shed_commands));
   add("readonly_commands", ld(stats_.readonly_commands));
+  // Zero-copy serving plane: the slab account (live/pinned bytes feed the
+  // watermark story; pinned = bytes held only by in-flight responses)
+  // plus the serve-path counters the bench A/B reads.
+  {
+    SlabStats slab = engine_->slab_stats();
+    add("slab_bytes", slab.bytes);
+    add("slab_blocks", slab.blocks);
+    add("slab_pinned_bytes", slab.pinned_bytes);
+    add("slab_allocs", slab.allocs);
+    add("slab_alloc_failures", slab.alloc_failures);
+  }
+  add("serve_zero_copy", ld(stats_.serve_zero_copy));
+  add("serve_value_copies", ld(stats_.serve_value_copies));
   // io plane: pool shape + per-worker counters (loop depth = commands /
   // wakeups; mean flush size = writev_bytes / writev_calls). Per-worker
   // lines let the top dashboard and /metrics see imbalance, not just sums.
   add("io_threads", workers_live_);
   add("io_pipelined", opts_.pipelined ? 1 : 0);
+  add("io_reuseport", reuseport_live_ ? 1 : 0);
   for (size_t i = 0; i < workers_live_; ++i) {
     const IoWorkerStats& ws = worker_stats_[i];
     const std::string p = "io_worker_" + std::to_string(i) + "_";
@@ -784,6 +943,7 @@ std::string Server::stats_text() {
     add(p + "wakeups", ld(ws.wakeups));
     add(p + "writev_calls", ld(ws.writev_calls));
     add(p + "writev_bytes", ld(ws.writev_bytes));
+    add(p + "accepts", ld(ws.accepts));
   }
   return out;
 }
@@ -927,15 +1087,33 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
   }
   switch (cmd.verb) {
     case Verb::Get: {
-      // The hot path: ONE copy of the value (out of the engine, under the
-      // shard lock), moved into the out queue — big values become their
-      // own iovec segment and are never copied again.
+      // The hot path, zero-copy: a ref on the value's immutable block
+      // (one atomic bump under the shard lock) rides the out queue as an
+      // iovec segment — NO copy of the value after ingest. The compat
+      // path (zero_copy=false, the bench A/B baseline) restores the PR 9
+      // discipline: one copy out of the engine, moved into the queue.
+      if (zero_copy_.load(std::memory_order_acquire)) {
+        BlockRef b = engine_->get_block(cmd.key);
+        if (!b) {
+          out.lit("NOT_FOUND\r\n");
+          return;
+        }
+        out.lit("VALUE ");
+        if (out.block(std::move(b))) {
+          stats_.serve_zero_copy.fetch_add(1, std::memory_order_relaxed);
+        }
+        out.lit("\r\n");
+        return;
+      }
       auto v = engine_->get(cmd.key);
       if (!v) {
         out.lit("NOT_FOUND\r\n");
         return;
       }
       out.lit("VALUE ");
+      if (v->size() > OutQueue::kInlinePayload) {
+        stats_.serve_value_copies.fetch_add(1, std::memory_order_relaxed);
+      }
       out.payload(std::move(*v));
       out.lit("\r\n");
       return;
@@ -973,7 +1151,18 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
     }
     case Verb::Set: {
       std::lock_guard lk(write_stripe(cmd.key));
+      // Discard any stale latch (an earlier Result-path refusal on this
+      // thread) so a non-slab failure below cannot misreport as BUSY.
+      (void)consume_slab_exhausted();
       if (!engine_->set(cmd.key, cmd.value)) {
+        // Slab-arena exhaustion is a typed, RETRYABLE refusal feeding the
+        // PR 8 ladder semantics: shed the write with the same BUSY-memory
+        // answer the shedding rung uses — never abort, never OOM.
+        if (consume_slab_exhausted()) {
+          stats_.shed_commands.fetch_add(1, std::memory_order_relaxed);
+          out.lit("ERROR BUSY memory retry\r\n");
+          return;
+        }
         out.lit("ERROR set failed\r\n");
         return;
       }
@@ -1264,6 +1453,15 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
                    ? engine_->increment(cmd.key, amount)
                    : engine_->decrement(cmd.key, amount);
       if (!r.ok) {
+        if (r.error == kSlabExhaustedError) {
+          // The typed error text is the verdict; consume the thread-local
+          // latch too so it cannot misattribute a LATER unrelated write
+          // failure on this io thread.
+          (void)consume_slab_exhausted();
+          stats_.shed_commands.fetch_add(1, std::memory_order_relaxed);
+          out.lit("ERROR BUSY memory retry\r\n");
+          return;
+        }
         out.lit("ERROR " + r.error + "\r\n");
         return;
       }
@@ -1291,6 +1489,12 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
       auto r = cmd.verb == Verb::Append ? engine_->append(cmd.key, cmd.value)
                                         : engine_->prepend(cmd.key, cmd.value);
       if (!r.ok) {
+        if (r.error == kSlabExhaustedError) {
+          (void)consume_slab_exhausted();  // see the INC/DEC branch
+          stats_.shed_commands.fetch_add(1, std::memory_order_relaxed);
+          out.lit("ERROR BUSY memory retry\r\n");
+          return;
+        }
         out.lit("ERROR " + r.error + "\r\n");
         return;
       }
@@ -1304,8 +1508,38 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
     }
     case Verb::MultiGet: {
       // Two passes: the found count must ride in the header BEFORE any
-      // value. Values are read once and MOVED into the out queue (their
-      // own iovec segments past the inline threshold).
+      // value. Zero-copy: each found value is a block ref acquired under
+      // its shard lock in pass one and handed to the queue in pass two —
+      // the refs double as the consistent read set (a concurrent DEL
+      // cannot invalidate a value between the passes).
+      if (zero_copy_.load(std::memory_order_acquire)) {
+        std::vector<BlockRef> vals;
+        vals.reserve(cmd.keys.size());
+        size_t found = 0;
+        for (const auto& k : cmd.keys) {
+          vals.push_back(engine_->get_block(k));
+          if (vals.back()) ++found;  // present values are 0+-byte blocks
+        }
+        if (found == 0) {
+          out.lit("NOT_FOUND\r\n");
+          return;
+        }
+        out.lit("VALUES " + std::to_string(found) + "\r\n");
+        for (size_t i = 0; i < cmd.keys.size(); ++i) {
+          out.lit(cmd.keys[i]);
+          if (vals[i]) {
+            out.lit(" ");
+            if (out.block(std::move(vals[i]))) {
+              stats_.serve_zero_copy.fetch_add(1,
+                                               std::memory_order_relaxed);
+            }
+            out.lit("\r\n");
+          } else {
+            out.lit(" NOT_FOUND\r\n");
+          }
+        }
+        return;
+      }
       std::vector<std::optional<std::string>> vals;
       vals.reserve(cmd.keys.size());
       size_t found = 0;
@@ -1322,6 +1556,10 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
         out.lit(cmd.keys[i]);
         if (vals[i]) {
           out.lit(" ");
+          if (vals[i]->size() > OutQueue::kInlinePayload) {
+            stats_.serve_value_copies.fetch_add(1,
+                                                std::memory_order_relaxed);
+          }
           out.payload(std::move(*vals[i]));
           out.lit("\r\n");
         } else {
@@ -1333,7 +1571,13 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
     case Verb::MultiSet: {
       for (const auto& [k, v] : cmd.pairs) {
         std::lock_guard lk(write_stripe(k));
+        (void)consume_slab_exhausted();  // discard any stale latch
         if (!engine_->set(k, v)) {
+          if (consume_slab_exhausted()) {
+            stats_.shed_commands.fetch_add(1, std::memory_order_relaxed);
+            out.lit("ERROR BUSY memory retry\r\n");
+            return;
+          }
           out.lit("ERROR set failed\r\n");
           return;
         }

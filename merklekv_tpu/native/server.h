@@ -30,6 +30,8 @@
 //     server.rs:909-923) or just stops the server (embedded mode).
 #pragma once
 
+#include <netinet/in.h>
+
 #include <array>
 #include <atomic>
 #include <chrono>
@@ -77,21 +79,40 @@ struct ServerOptions {
   // so `io_threads=1, pipelined=false` approximates the old
   // thread-per-connection blocking loop from the server side.
   bool pipelined = true;
+  // SO_REUSEPORT accept sharding: 0 = auto (use it where the kernel
+  // supports it), 1 = on (fall back with a note if unsupported), -1 = off
+  // (single accept loop only). When active, every io worker owns its OWN
+  // listening socket on the served port and the kernel deals connections
+  // across them — the single accept thread stops being the
+  // connection-storm bottleneck. Admission control (max_connections,
+  // draining refusal, BUSY-in-accept) is enforced identically on both
+  // paths against the shared connection count.
+  int reuseport = 0;
 };
 
 // Per-connection response staging, flushed with one writev (sendmsg) per
-// burst. Protocol literals coalesce into the open tail segment; served
-// values larger than kInlinePayload ride as their OWN (moved) segments —
-// a value is copied exactly once (out of the engine, under the shard
-// lock, which is what makes its lifetime safe once the lock drops) and
-// then never copied again on its way to the socket: the segment string
-// IS the iovec the kernel reads.
+// burst. Protocol literals coalesce into the open tail segment; computed
+// bodies larger than kInlinePayload ride as their OWN (moved) string
+// segments; served values ride as REFCOUNTED ENGINE BLOCKS — zero copies
+// after ingest: the block the engine materialized at SET time is the
+// iovec the kernel reads, and the queue's ref is the response's pin on
+// it. The ref drops only when the segment is fully written (or the
+// connection dies), so a DEL/overwrite can never free bytes a parked
+// writev still needs — a slow reader pins memory, never corrupts it.
 struct OutQueue {
   // Below this, memcpy into the coalesced literal beats the extra iovec
   // entry + allocator churn of a dedicated segment.
   static constexpr size_t kInlinePayload = 512;
 
-  std::vector<std::string> segs;
+  // One iovec-to-be: an owned byte string OR a zero-copy engine block.
+  struct Seg {
+    std::string str;
+    BlockRef block;  // when set, the segment's bytes are the block's
+    const char* data() const { return block ? block.data() : str.data(); }
+    size_t size() const { return block ? block.size() : str.size(); }
+  };
+
+  std::vector<Seg> segs;
   size_t head = 0;      // first segment with unwritten bytes
   size_t head_off = 0;  // bytes of segs[head] already written
   size_t bytes = 0;     // unwritten bytes across all segments
@@ -103,23 +124,38 @@ struct OutQueue {
       segs.emplace_back();
       tail_open = true;
     }
-    segs.back().append(s.data(), s.size());
+    segs.back().str.append(s.data(), s.size());
     bytes += s.size();
   }
-  // Computed response body or served value: moved, not re-copied, when it
-  // is big enough for the extra segment to pay for itself.
+  // Computed response body: moved, not re-copied, when it is big enough
+  // for the extra segment to pay for itself.
   void payload(std::string&& v) {
     if (v.size() <= kInlinePayload) {
       lit(v);
       return;
     }
     bytes += v.size();
-    segs.push_back(std::move(v));
+    segs.push_back(Seg{std::move(v), {}});
     tail_open = false;
+  }
+  // Served value: the block rides as its own segment holding its own ref
+  // (zero-copy). Small values still memcpy into the coalesced literal —
+  // cheaper than an iovec entry, and the copy is tiny by definition.
+  // Returns true when the block path was taken (the serve_zero_copy
+  // counter's signal).
+  bool block(BlockRef&& b) {
+    if (b.size() <= kInlinePayload) {
+      lit(b.view());
+      return false;
+    }
+    bytes += b.size();
+    segs.push_back(Seg{{}, std::move(b)});
+    tail_open = false;
+    return true;
   }
   bool empty() const { return bytes == 0; }
   void reset() {
-    segs.clear();
+    segs.clear();  // drops every block ref the flush completed
     head = 0;
     head_off = 0;
     bytes = 0;
@@ -136,6 +172,9 @@ struct IoWorkerStats {
   std::atomic<uint64_t> wakeups{0};       // epoll_wait returns with events
   std::atomic<uint64_t> writev_calls{0};  // flush syscalls
   std::atomic<uint64_t> writev_bytes{0};  // bytes those syscalls moved
+  // Connections this worker accepted on its OWN reuseport listener
+  // (0 everywhere when accept sharding is off — the distribution signal).
+  std::atomic<uint64_t> accepts{0};
 };
 
 // Slow-command log (the native half of the flight recorder): dispatch
@@ -259,6 +298,28 @@ class Server {
   }
   size_t io_threads() const { return workers_live_; }
   bool pipelined() const { return opts_.pipelined; }
+  // SO_REUSEPORT accept sharding (-1 off, 0 auto, 1 on); fixed at start().
+  void configure_accept(int reuseport) {
+    if (started_) return;
+    opts_.reuseport = reuseport < 0 ? -1 : reuseport > 0 ? 1 : 0;
+  }
+  // True once start() actually sharded the accept path (auto/on AND the
+  // kernel granted SO_REUSEPORT on every worker's listener).
+  bool reuseport_active() const { return reuseport_live_; }
+  // Request-line byte cap (a SET of a large value needs headroom beyond
+  // the 1 MiB default); fixed at start().
+  void set_max_line(size_t n) {
+    if (!started_ && n > 0) opts_.max_line = n;
+  }
+  // Zero-copy serving A/B: when off, GET/MGET restore the PR 9 discipline
+  // (value copied out of the engine under the shard lock, moved into the
+  // queue) — the bench's compat baseline. Wire-identical either way.
+  void set_zero_copy(bool on) {
+    zero_copy_.store(on, std::memory_order_release);
+  }
+  bool zero_copy() const {
+    return zero_copy_.load(std::memory_order_acquire);
+  }
   // Change-event staging is opt-in: without a drainer (standalone binary,
   // replication disabled) staging would pin up to capacity keys+values.
   void set_events_enabled(bool on) {
@@ -343,6 +404,14 @@ class Server {
   std::mutex& write_stripe(const std::string& key);
   void stage_event(ChangeOp op, const std::string& key,
                    const std::string& value, bool has_value);
+  // Accept-path admission shared by the classic accept loop and the
+  // per-worker reuseport listeners: true = refused (BUSY answered on the
+  // still-blocking fd, closed, counted) against the SHARED connection
+  // count, so PR 8 semantics hold no matter which socket accepted.
+  bool refuse_admission(int fd);
+  // Post-admission connection setup (meta, client table, counters,
+  // TCP_NODELAY + O_NONBLOCK), shared by both accept paths.
+  std::shared_ptr<ClientMeta> register_conn(int fd, const sockaddr_in& peer);
 
   Engine* engine_;
   ServerOptions opts_;
@@ -358,6 +427,8 @@ class Server {
   std::atomic<size_t> max_pipeline_{0};
   std::atomic<int> degradation_{0};     // Degradation enum value
   std::atomic<int> degrade_reason_{0};  // DegradeReason enum value
+  std::atomic<bool> zero_copy_{true};   // GET/MGET block path vs compat copy
+  bool reuseport_live_ = false;         // accept sharding resolved at start
   std::atomic<uint64_t> slow_threshold_us_{0};  // 0 = slow log off
   FlightLog flight_;
   static constexpr size_t kWriteStripes = 64;

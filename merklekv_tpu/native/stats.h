@@ -85,6 +85,16 @@ struct ServerStats {
   std::atomic<uint64_t> shed_commands{0};
   std::atomic<uint64_t> readonly_commands{0};
 
+  // Zero-copy serving plane (extension lines):
+  //   serve_zero_copy     — values (> OutQueue::kInlinePayload) served as
+  //                         refcounted block segments: zero copies after
+  //                         ingest.
+  //   serve_value_copies  — values that size that were COPIED out of the
+  //                         engine instead (zero_copy=false compat path) —
+  //                         the bench A/B's allocations/op numerator.
+  std::atomic<uint64_t> serve_zero_copy{0};
+  std::atomic<uint64_t> serve_value_copies{0};
+
   LatencyHisto latency;
 
   uint64_t uptime_seconds() const {

@@ -192,11 +192,11 @@ void pump_worker(uint16_t port, int iters) {
   ::close(fd);
 }
 
-void slow_reader_worker(uint16_t port, int gets) {
+void slow_reader_worker(uint16_t port, int gets, const std::string& key) {
   int fd = connect_to(port);
   if (fd < 0) return;
   std::string burst;
-  for (int i = 0; i < gets; ++i) burst += "GET bigkey\r\n";
+  for (int i = 0; i < gets; ++i) burst += "GET " + key + "\r\n";
   if (::send(fd, burst.data(), burst.size(), MSG_NOSIGNAL) < 0) {
     ::close(fd);
     return;
@@ -250,7 +250,8 @@ void stress_pipelined_pool() {
   for (int t = 0; t < 12; ++t) {
     clients.emplace_back(pipelined_worker, server.port(), t, 40, 32);
   }
-  clients.emplace_back(slow_reader_worker, server.port(), 200);
+  clients.emplace_back(slow_reader_worker, server.port(), 200,
+                       std::string("bigkey"));
   // Two pump threads: forced TREELEVEL rebuilds + stamped HASH/LEAFHASHES
   // racing the write storm and each other over tree_mu_ / engine version.
   clients.emplace_back(pump_worker, server.port(), 200);
@@ -316,6 +317,127 @@ void stress_guard_pump_scrub() {
   running.store(false, std::memory_order_release);
   scrubber.join();
   warmer.join();
+  server.stop();
+  server.wait();
+}
+
+// Zero-copy refcount churn (ISSUE 14): a GET storm serves refcounted
+// slab blocks over the wire while overwrite/DEL/tombstone-eviction churn
+// hammers the SAME keys — every served block's lifetime races the
+// engine dropping its ref — plus direct get_block holders, a snapshot/
+// leaf reader, and a slow reader whose parked writev pins blocks across
+// their deletion. The refcount protocol (ref under shard lock, unref on
+// flush/teardown, account settle on free) must keep every combination
+// clean.
+void zc_get_worker(uint16_t port, int bursts, int depth) {
+  int fd = connect_to(port);
+  if (fd < 0) return;
+  for (int b = 0; b < bursts; ++b) {
+    std::string burst;
+    for (int j = 0; j < depth; ++j) {
+      char cmd[64];
+      std::snprintf(cmd, sizeof(cmd), "GET zc:%d\r\n", (b + j) % 16);
+      burst += cmd;
+    }
+    if (::send(fd, burst.data(), burst.size(), MSG_NOSIGNAL) < 0) break;
+    int newlines = 0;
+    char buf[65536];
+    while (newlines < depth) {
+      ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+      if (r <= 0) {
+        ::close(fd);
+        return;
+      }
+      for (ssize_t i = 0; i < r; ++i) {
+        if (buf[i] == '\n') ++newlines;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void stress_zero_copy_churn() {
+  // Tiny tombstone cap: the DEL churn below overflows it constantly, so
+  // tombstone EVICTION (the third leg of the churn triad) runs under the
+  // same load instead of needing ~1M deletes.
+  ::setenv("MKV_MAX_TOMBS_PER_SHARD", "8", 1);
+  auto engine = std::make_unique<mkv::MemEngine>();
+  ::unsetenv("MKV_MAX_TOMBS_PER_SHARD");
+  const std::string big(64 * 1024, 'Z');
+  const std::string mid(8 * 1024, 'v');
+  for (int i = 0; i < 16; ++i) {
+    engine->set("zc:" + std::to_string(i), mid);
+  }
+  engine->set("zcbig", big);
+  mkv::ServerOptions opts;
+  opts.port = 0;
+  opts.io_threads = 4;
+  mkv::Server server(engine.get(), opts);
+  if (!server.start()) {
+    std::fprintf(stderr, "bind failed\n");
+    std::exit(1);
+  }
+  std::atomic<bool> running{true};
+  // Overwrite / DEL / tombstone-evict churn on the SAME keys the GET
+  // storm serves: the engine's ref drops race every in-flight response's.
+  std::vector<std::thread> churn;
+  for (int t = 0; t < 2; ++t) {
+    churn.emplace_back([&engine, &mid, t] {
+      for (int i = 0; i < 1500; ++i) {
+        const std::string k = "zc:" + std::to_string((t * 7 + i) % 16);
+        switch (i % 5) {
+          case 0: engine->set(k, mid); break;
+          case 1: engine->del_with_ts(k, uint64_t(i) + 1); break;
+          case 2: engine->set_if_newer(k, mid, UINT64_MAX - 1); break;
+          case 3: engine->del_quiet(k); break;
+          default: engine->set(k, "tiny-" + std::to_string(i)); break;
+        }
+      }
+    });
+  }
+  // Direct block holders: take a ref, read it, drop it — the exact
+  // engine-side race a worker's dispatch runs, without the socket.
+  for (int t = 0; t < 2; ++t) {
+    churn.emplace_back([&engine] {
+      size_t total = 0;
+      for (int i = 0; i < 3000; ++i) {
+        mkv::BlockRef b = engine->get_block("zc:" + std::to_string(i % 16));
+        if (b) {
+          // Touch the bytes: a use-after-free here is what TSAN+ASAN-
+          // style tooling must never see.
+          total += b.size() ? size_t(b.data()[b.size() - 1]) : 0;
+        }
+      }
+      (void)total;
+    });
+  }
+  // Snapshot/leaf reader: whole-keyspace reads (what the Merkle plane
+  // does) racing the churn and the block drops.
+  churn.emplace_back([&engine, &running] {
+    while (running.load(std::memory_order_acquire)) {
+      engine->snapshot();
+      engine->memory_usage();
+      engine->slab_stats();
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  });
+  std::vector<std::thread> clients;
+  for (int t = 0; t < 8; ++t) {
+    clients.emplace_back(zc_get_worker, server.port(), 40, 24);
+  }
+  // Slow reader parked on the big value while the churn overwrites it:
+  // its queued blocks must pin the ORIGINAL bytes until drained.
+  clients.emplace_back(slow_reader_worker, server.port(), 100,
+                       std::string("zcbig"));
+  churn.emplace_back([&engine, &big] {
+    for (int i = 0; i < 200; ++i) {
+      engine->set("zcbig", i % 2 ? big : "small");
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  for (auto& t : clients) t.join();
+  running.store(false, std::memory_order_release);
+  for (auto& t : churn) t.join();
   server.stop();
   server.wait();
 }
@@ -407,6 +529,8 @@ int main() {
   std::fprintf(stderr, "pipelined pool: ok\n");
   stress_guard_pump_scrub();
   std::fprintf(stderr, "guard/pump/scrub readers: ok\n");
+  stress_zero_copy_churn();
+  std::fprintf(stderr, "zero-copy refcount churn: ok\n");
   stress_stop_races();
   std::fprintf(stderr, "stop races: ok\n");
   std::puts("TSAN STRESS PASS");

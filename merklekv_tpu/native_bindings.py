@@ -134,6 +134,10 @@ def _load() -> ctypes.CDLL:
     lib.mkv_engine_memory_usage.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_tomb_evictions.restype = ctypes.c_longlong
     lib.mkv_engine_tomb_evictions.argtypes = [ctypes.c_void_p]
+    lib.mkv_engine_slab_stats.restype = None
+    lib.mkv_engine_slab_stats.argtypes = [
+        ctypes.c_void_p, P(ctypes.c_ulonglong),
+    ]
     lib.mkv_engine_version.restype = ctypes.c_ulonglong
     lib.mkv_engine_version.argtypes = [ctypes.c_void_p]
     lib.mkv_engine_log_version_refused.argtypes = [ctypes.c_void_p]
@@ -170,6 +174,12 @@ def _load() -> ctypes.CDLL:
     ]
     lib.mkv_server_io_threads.restype = ctypes.c_longlong
     lib.mkv_server_io_threads.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_configure_accept.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mkv_server_reuseport.argtypes = [ctypes.c_void_p]
+    lib.mkv_server_set_zero_copy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mkv_server_set_max_line.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong,
+    ]
     lib.mkv_server_start.argtypes = [ctypes.c_void_p]
     lib.mkv_server_port.argtypes = [ctypes.c_void_p]
     lib.mkv_server_stopping.argtypes = [ctypes.c_void_p]
@@ -392,6 +402,22 @@ class NativeEngine:
     def memory_usage(self) -> int:
         return self._lib.mkv_engine_memory_usage(self._h)
 
+    def slab_stats(self) -> dict[str, int]:
+        """Value-slab accounting snapshot: ``bytes`` (live payload bytes,
+        INCLUDING blocks pinned only by in-flight responses), ``blocks``,
+        ``pinned_bytes`` (the in-flight-only subset), ``allocs`` (lifetime)
+        and ``alloc_failures`` (writes refused by the MKV_MAX_SLAB_BYTES
+        arena limit). Zeros for engines without block storage."""
+        out = (ctypes.c_ulonglong * 5)()
+        self._lib.mkv_engine_slab_stats(self._h, out)
+        return {
+            "bytes": int(out[0]),
+            "blocks": int(out[1]),
+            "pinned_bytes": int(out[2]),
+            "allocs": int(out[3]),
+            "alloc_failures": int(out[4]),
+        }
+
     def version(self) -> int:
         """Engine mutation version (bumped per write). Only the sharded
         ("mem") and log engines track real versions; other kinds fall back
@@ -532,7 +558,16 @@ class NativeServer:
         exit_on_shutdown: bool = False,
         io_threads: int = 0,
         pipelined: bool = True,
+        reuseport: str = "auto",
+        zero_copy: bool = True,
+        max_line: int = 0,
     ) -> None:
+        # Validate BEFORE mkv_server_create: a raise past that point would
+        # leak the native handle (there is no __del__ to reclaim it).
+        if reuseport not in ("auto", "on", "off"):
+            raise ValueError(
+                f"reuseport must be auto|on|off, got {reuseport!r}"
+            )
         self._lib = _load()
         self._engine = engine  # keep alive
         self._h = self._lib.mkv_server_create(
@@ -549,6 +584,21 @@ class NativeServer:
         self._lib.mkv_server_configure_io(
             self._h, io_threads, 1 if pipelined else 0
         )
+        # Accept sharding: "auto" uses SO_REUSEPORT where the kernel
+        # supports it (each io worker owns its own listener), "on" insists
+        # (falls back with a note where unsupported), "off" keeps the
+        # single accept loop. Admission control is identical either way.
+        self._lib.mkv_server_configure_accept(
+            self._h, {"off": -1, "auto": 0, "on": 1}[reuseport]
+        )
+        # Zero-copy serving A/B (default on): off restores the copy-out-
+        # of-the-engine GET/MGET path — wire-identical, bench baseline.
+        if not zero_copy:
+            self._lib.mkv_server_set_zero_copy(self._h, 0)
+        # Request-line cap (0 keeps the 1 MiB default); a SET of a ~1 MiB
+        # value needs line headroom beyond the value itself.
+        if max_line > 0:
+            self._lib.mkv_server_set_max_line(self._h, max_line)
 
     def start(self) -> None:
         if not self._lib.mkv_server_start(self._h):
@@ -560,6 +610,20 @@ class NativeServer:
         if not self._h:
             return 0
         return int(self._lib.mkv_server_io_threads(self._h))
+
+    @property
+    def reuseport(self) -> bool:
+        """True once start() actually sharded the accept path (every io
+        worker owns its own SO_REUSEPORT listener)."""
+        if not self._h:
+            return False
+        return bool(self._lib.mkv_server_reuseport(self._h))
+
+    def set_zero_copy(self, on: bool = True) -> None:
+        """Flip the zero-copy serving path (the bench flips it off for
+        the compat A/B baseline; wire behavior is identical)."""
+        if self._h:
+            self._lib.mkv_server_set_zero_copy(self._h, 1 if on else 0)
 
     @property
     def port(self) -> int:

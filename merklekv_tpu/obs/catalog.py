@@ -266,6 +266,29 @@ CATALOG: dict[str, tuple[str, str]] = {
     "native.readonly_commands": (
         "counter", "Write commands answered ERROR READONLY while "
         "read-only/draining."),
+    # -- zero-copy serving plane (value slabs; [server] zero_copy) ---------
+    "native.slab_bytes": (
+        "gauge", "Live value-slab payload bytes, INCLUDING blocks pinned "
+        "only by in-flight responses (the memory-watermark signal)."),
+    "native.slab_blocks": (
+        "gauge", "Live refcounted value blocks."),
+    "native.slab_pinned_bytes": (
+        "gauge", "Slab bytes not held by the live keyspace: in-flight "
+        "responses (a slow reader's parked writev pins value memory here "
+        "until it drains) plus values transiently mid-ingest — a "
+        "SUSTAINED rise means slow readers, brief spikes are writes."),
+    "native.slab_allocs": (
+        "counter", "Lifetime value-block allocations (one per ingested "
+        "value; zero-copy GETs allocate nothing)."),
+    "native.slab_alloc_failures": (
+        "counter", "Writes refused by the slab-arena byte limit "
+        "(MKV_MAX_SLAB_BYTES) and shed with ERROR BUSY memory."),
+    "native.serve_zero_copy": (
+        "counter", "Values served as refcounted block iovec segments — "
+        "zero copies after ingest."),
+    "native.serve_value_copies": (
+        "counter", "Values that size copied out of the engine instead "
+        "(the zero_copy=false compat path; the bench A/B numerator)."),
     # -- native io plane (epoll worker pool; per-worker families are
     #    labeled {worker="i"}) ---------------------------------------------
     "native.io_threads": (
@@ -288,6 +311,12 @@ CATALOG: dict[str, tuple[str, str]] = {
     "native.io_worker_writev_bytes": (
         "counter", "Bytes flushed by each io worker's writev calls (with "
         "writev_calls: mean bytes per flush)."),
+    "native.io_reuseport": (
+        "gauge", "1 when SO_REUSEPORT accept sharding is live (every io "
+        "worker owns its own listener); 0 on the single accept loop."),
+    "native.io_worker_accepts": (
+        "counter", "Connections each io worker accepted on its OWN "
+        "reuseport listener (all zero when accept sharding is off)."),
 }
 
 

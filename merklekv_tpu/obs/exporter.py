@@ -50,6 +50,7 @@ _IO_WORKER_KINDS = {
     "wakeups": "counter",
     "writev_calls": "counter",
     "writev_bytes": "counter",
+    "accepts": "counter",
 }
 
 
@@ -269,6 +270,10 @@ def render_prometheus(
                 "tombstone_evictions",
                 "events_dropped",
                 "pipeline_rejected",
+                "serve_zero_copy",
+                "serve_value_copies",
+                "slab_allocs",
+                "slab_alloc_failures",
             ):
                 out.append(
                     f"# HELP mkv_native_{san} "

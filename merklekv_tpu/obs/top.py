@@ -86,6 +86,10 @@ class NodeSample:
     # the worker pool).
     io_threads: int = 0
     worker_commands: dict = field(default_factory=dict)
+    # Zero-copy serving plane (STATS io_worker_<i>_writev_bytes summed):
+    # cumulative bytes the io workers flushed to sockets — rendered as the
+    # SRV_MB/S column (served-bytes rate; 0 on nodes predating the pool).
+    served_bytes: int = 0
     # Flight-recorder pane (--events): newest black-box events via the
     # FLIGHT verb, one dict per event ([] on nodes predating the verb or
     # when --events is off).
@@ -154,6 +158,11 @@ def sample_node(
         if name.startswith("io_worker_") and name.endswith("_commands"):
             try:
                 s.worker_commands[name] = int(value)
+            except ValueError:
+                continue
+        elif name.startswith("io_worker_") and name.endswith("_writev_bytes"):
+            try:
+                s.served_bytes += int(value)
             except ValueError:
                 continue
     s.sync_bytes = int(metrics.get("sync.bytes_sent", 0) or 0) + int(
@@ -237,7 +246,8 @@ def render_table(
 ) -> str:
     header = (
         f"{'NODE':<22} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} {'GET/S':>8} "
-        f"{'P50_US':>7} {'SYNC_KB/S':>10} {'CONNS':>5} {'W':>3} "
+        f"{'P50_US':>7} {'SRV_MB/S':>9} {'SYNC_KB/S':>10} {'CONNS':>5} "
+        f"{'W':>3} "
         f"{'OPS/S/W':>8} {'PEERS_UP':>9} "
         f"{'LAG_EV':>7} {'LAG_MS':>8} {'STALE':>6} {'VER':>5} "
         f"{'BKND':>5} {'READY':>8} {'STATE':>9} "
@@ -249,7 +259,8 @@ def render_table(
         p = prev.get(node)
         if not c.ok:
             lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
-                         f"{'-':>7} {'-':>10} {'-':>5} {'-':>3} {'-':>8} "
+                         f"{'-':>7} {'-':>9} {'-':>10} {'-':>5} {'-':>3} "
+                         f"{'-':>8} "
                          f"{'-':>9} "
                          f"{'-':>7} {'-':>8} {'-':>6} {'-':>5} {'-':>5} "
                          f"{'-':>8} {'-':>9} {'-':>7} "
@@ -261,6 +272,13 @@ def render_table(
         gets = _rate(c.get_commands, p.get_commands, dt) if dt else 0.0
         sync_kb = (
             _rate(c.sync_bytes, p.sync_bytes, dt) / 1024.0 if dt else 0.0
+        )
+        # SRV MB/s = response bytes the io workers flushed (writev) — the
+        # large-value serving throughput the zero-copy path exists for.
+        srv_mb = (
+            _rate(c.served_bytes, p.served_bytes, dt) / (1024.0 * 1024.0)
+            if dt
+            else 0.0
         )
         shed = _rate(c.shed_total, p.shed_total, dt) if dt else 0.0
         # Busiest io worker's command rate: the imbalance signal — one hot
@@ -291,7 +309,8 @@ def render_table(
         bknd = f"{c.backend_level}" if c.backend_level >= -1 else "-"
         lines.append(
             f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
-            f"{p50:>7} {sync_kb:>10.1f} {c.active_connections:>5} "
+            f"{p50:>7} {srv_mb:>9.1f} {sync_kb:>10.1f} "
+            f"{c.active_connections:>5} "
             f"{w:>3} {per_worker:>8.1f} "
             f"{peers:>9} {c.lag_events:>7} {c.lag_ms:>8.1f} "
             f"{stale:>6} {ver:>5} {bknd:>5} "

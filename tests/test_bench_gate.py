@@ -79,6 +79,18 @@ def test_direction_rules():
         "sharded_rebuild_diff_keys_per_s",
         "keys/s (rebuild + 8-replica diff over the key mesh)",
     )
+    # Zero-copy serving A/B: GB/s is throughput (must not DROP)...
+    assert not bench_gate.lower_is_better(
+        "large_value_throughput",
+        "GB/s (64 conns pipelined GET, 1MiB hot values)",
+    )
+    # ...while serve-path allocations/op is a per-op COST, not a rate:
+    # the "/op" unit (and the _per_op suffix) must read down-good, or a
+    # change that reintroduces the serve copy would gate as an
+    # improvement.
+    assert bench_gate.lower_is_better("large_value_alloc_per_op",
+                                      "allocs/op")
+    assert bench_gate.lower_is_better("anything_per_op", "")
 
 
 def test_compare_flags_only_real_regressions():

@@ -1,0 +1,495 @@
+"""Zero-copy serving path: refcounted value slabs + reuseport accept
+sharding (ISSUE 14).
+
+Covers the tentpole invariants — a served block's lifetime survives
+DEL/overwrite of its key (slow-reader pin), slab accounting counts
+reader-pinned bytes so the memory watermarks stay honest, slab-arena
+exhaustion sheds with a typed retryable BUSY, Merkle roots are
+bit-identical across the zero-copy/compat A/B — plus the accept-shard
+distribution contract and the client-side max_value_bytes fix.
+"""
+
+import asyncio
+import socket
+import time
+
+import pytest
+
+from merklekv_tpu.client import (
+    AsyncMerkleKVClient,
+    MerkleKVClient,
+    ServerBusyError,
+)
+from merklekv_tpu.config import Config
+from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+
+def _wait(pred, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ----------------------------------------------------------- slab basics
+
+
+def test_slab_accounting_tracks_engine_state():
+    with NativeEngine("mem") as eng:
+        assert eng.slab_stats() == {
+            "bytes": 0, "blocks": 0, "pinned_bytes": 0, "allocs": 0,
+            "alloc_failures": 0,
+        }
+        eng.set(b"a", b"x" * 1000)
+        eng.set(b"b", b"y" * 500)
+        st = eng.slab_stats()
+        assert st["bytes"] == 1500
+        assert st["blocks"] == 2
+        assert st["pinned_bytes"] == 0
+        assert st["allocs"] == 2
+        # Overwrite replaces the block; DEL frees it.
+        eng.set(b"a", b"z" * 10)
+        assert eng.slab_stats()["bytes"] == 510
+        eng.delete(b"b")
+        st = eng.slab_stats()
+        assert st["bytes"] == 10 and st["blocks"] == 1
+        # memory_usage = key bytes + slab bytes.
+        assert eng.memory_usage() == 1 + 10
+        eng.truncate()
+        assert eng.slab_stats()["bytes"] == 0
+        assert eng.memory_usage() == 0
+
+
+def test_log_engine_delegates_slab_stats(tmp_path):
+    with NativeEngine("log", str(tmp_path / "d")) as eng:
+        eng.set(b"k", b"v" * 256)
+        assert eng.slab_stats()["bytes"] == 256
+
+
+# ------------------------------------------------- wire parity + A/B root
+
+
+@pytest.fixture
+def zc_pair():
+    """One pre-seeded engine served by a zero-copy server and a compat
+    (zero_copy=False) server at once — the A/B surface."""
+    eng = NativeEngine("mem")
+    zc = NativeServer(eng, "127.0.0.1", 0, max_line=4 << 20)
+    compat = NativeServer(
+        eng, "127.0.0.1", 0, zero_copy=False, max_line=4 << 20
+    )
+    zc.start()
+    compat.start()
+    yield eng, zc, compat
+    compat.close()
+    zc.close()
+    eng.close()
+
+
+def test_wire_identical_and_root_identical_across_ab(zc_pair):
+    eng, zc, compat = zc_pair
+    vals = {
+        "small": "s",
+        "mid": "m" * 600,              # > inline threshold: block segment
+        "big": "B" * (256 << 10),
+    }
+    with MerkleKVClient("127.0.0.1", zc.port) as a, MerkleKVClient(
+        "127.0.0.1", compat.port
+    ) as b:
+        for k, v in vals.items():
+            a.set(k, v)
+        for k, v in vals.items():
+            assert a.get(k) == v, k
+            assert b.get(k) == v, k
+        assert a.mget(list(vals)) == b.mget(list(vals)) == vals
+        assert a.get("missing") is None
+        # Bit-identical Merkle root across the serve paths.
+        assert a.hash() == b.hash()
+        sa = a.stats()
+        sb = b.stats()
+        assert int(sa["serve_zero_copy"]) >= 2  # mid + big
+        assert int(sa["serve_value_copies"]) == 0
+        assert int(sb["serve_value_copies"]) >= 2
+        assert int(sb["serve_zero_copy"]) == 0
+
+
+# ------------------------------------------------------- slow-reader pin
+
+
+def test_slow_reader_pins_values_across_del_overwrite_and_evict():
+    """Park 16 MiB of large values behind EPOLLOUT, then overwrite, DEL
+    and tombstone-evict the keys: every parked byte must arrive intact
+    (the response pins the value version at dispatch time), the pinned
+    bytes must stay visible to memory_usage(), and the slab must release
+    once the reader drains."""
+    n_keys, size = 16, 1 << 20
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, io_threads=2)
+    srv.start()
+    try:
+        patterns = {
+            i: bytes([97 + i % 26]) * size for i in range(n_keys)
+        }
+        for i, pat in patterns.items():
+            eng.set(b"pin:%d" % i, pat)
+        base = eng.slab_stats()
+        assert base["bytes"] == n_keys * size
+
+        # Two parked readers, 8 MiB each (the per-connection output
+        # backlog caps at the kOutHigh backpressure watermark, 8 MiB, by
+        # design — 16 MiB parks across two conns). Tiny receive buffers
+        # (set BEFORE connect so the window honors them) keep the kernel
+        # from absorbing the responses.
+        socks = []
+        for half in range(2):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16384)
+            s.settimeout(30)
+            s.connect(("127.0.0.1", srv.port))
+            s.sendall(
+                b"".join(
+                    b"GET pin:%d\r\n" % i
+                    for i in range(half * 8, half * 8 + 8)
+                )
+            )
+            socks.append(s)
+
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            # Every GET dispatched (responses staged, refs taken) before
+            # the churn begins.
+            assert _wait(
+                lambda: int(c.stats().get("serve_zero_copy", 0)) >= n_keys
+            ), c.stats().get("serve_zero_copy")
+            # Churn the keys while the reader is parked: overwrite a
+            # third, DEL the rest (tombstones).
+            for i in range(n_keys):
+                if i % 3 == 0:
+                    c.set(f"pin:{i}", "tiny")
+                else:
+                    c.delete(f"pin:{i}")
+        # The engine dropped its refs: the old blocks are now pinned ONLY
+        # by the parked responses — and still counted by memory_usage so
+        # the watermarks see them.
+        assert _wait(
+            lambda: eng.slab_stats()["pinned_bytes"] >= 8 * size
+        ), eng.slab_stats()
+        st = eng.slab_stats()
+        assert eng.memory_usage() >= st["pinned_bytes"]
+
+        # Drain: every parked byte must be the ORIGINAL value bytes.
+        for half, s in enumerate(socks):
+            buf = bytearray()
+            while buf.count(b"\n") < 8:
+                chunk = s.recv(1 << 18)
+                assert chunk, "server closed mid-drain"
+                buf.extend(chunk)
+            lines = bytes(buf).split(b"\r\n")
+            for j in range(8):
+                i = half * 8 + j
+                assert lines[j] == b"VALUE " + patterns[i], (
+                    f"pin:{i} corrupt"
+                )
+            s.close()
+
+        # After the drain the pins release: only the overwritten tiny
+        # values remain in the slab.
+        live = sum(4 for i in range(n_keys) if i % 3 == 0)
+        assert _wait(
+            lambda: eng.slab_stats()["bytes"] == live
+            and eng.slab_stats()["pinned_bytes"] == 0
+        ), eng.slab_stats()
+    finally:
+        srv.close()
+        eng.close()
+
+
+# ------------------------------------------------------ arena exhaustion
+
+
+def test_slab_exhaustion_sheds_with_busy_memory(monkeypatch):
+    monkeypatch.setenv("MKV_MAX_SLAB_BYTES", str(1 << 20))
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, max_line=4 << 20)
+    srv.start()
+    try:
+        with MerkleKVClient("127.0.0.1", srv.port) as c:
+            c.set("a", "x" * (512 << 10))
+            # Second write would cross the 1 MiB arena: typed retryable
+            # BUSY, never an abort/OOM.
+            with pytest.raises(ServerBusyError, match="memory"):
+                c.set("b", "y" * (700 << 10))
+            # APPEND past the limit sheds the same way.
+            with pytest.raises(ServerBusyError, match="memory"):
+                c.append("a", "z" * (700 << 10))
+            assert eng.slab_stats()["alloc_failures"] >= 2
+            # The shed is recoverable: free space, retry, it lands.
+            assert c.delete("a") is True
+            c.set("b", "y" * (700 << 10))
+            assert len(c.get("b")) == 700 << 10
+            st = c.stats()
+            assert int(st["slab_alloc_failures"]) >= 2
+            assert int(st["shed_commands"]) >= 2
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_slab_exhaustion_engine_level(monkeypatch):
+    monkeypatch.setenv("MKV_MAX_SLAB_BYTES", "1000")
+    from merklekv_tpu.native_bindings import NativeError
+
+    with NativeEngine("mem") as eng:
+        eng.set(b"a", b"x" * 900)
+        with pytest.raises(NativeError):
+            eng.set(b"b", b"y" * 200)
+        assert eng.slab_stats()["alloc_failures"] == 1
+        eng.delete(b"a")
+        eng.set(b"b", b"y" * 200)  # recovers
+
+
+def test_overwrite_near_arena_limit_is_admitted(monkeypatch):
+    """Overwriting (or shrinking) an existing key must not be refused by
+    the arena cap: the replaced value's bytes credit the limit check, so
+    the retryable BUSY is never handed out for a write no retry could
+    ever satisfy (the old value only leaves the account on install)."""
+    monkeypatch.setenv("MKV_MAX_SLAB_BYTES", "1000")
+    from merklekv_tpu.native_bindings import NativeError
+
+    with NativeEngine("mem") as eng:
+        eng.set(b"a", b"x" * 900)
+        eng.set(b"a", b"y" * 200)   # shrink: would double-charge w/o credit
+        eng.set(b"a", b"z" * 900)   # same-size class overwrite admitted
+        assert eng.get(b"a") == b"z" * 900
+        # A genuinely NEW key past the cap still sheds.
+        with pytest.raises(NativeError):
+            eng.set(b"b", b"w" * 200)
+        assert eng.slab_stats()["alloc_failures"] == 1
+
+
+# ------------------------------------------------- accept-shard contract
+
+
+def _worker_accepts(stats: dict) -> dict:
+    return {
+        k: int(v) for k, v in stats.items()
+        if k.startswith("io_worker_") and k.endswith("_accepts")
+    }
+
+
+def test_reuseport_distributes_accepts_across_workers():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, io_threads=4, reuseport="on")
+    srv.start()
+    try:
+        assert srv.reuseport is True
+        conns = [
+            MerkleKVClient("127.0.0.1", srv.port).connect()
+            for _ in range(48)
+        ]
+        for c in conns:
+            assert c.ping().startswith("PONG")
+        stats = conns[0].stats()
+        assert stats["io_reuseport"] == "1"
+        accepts = _worker_accepts(stats)
+        assert len(accepts) == 4
+        # The kernel deals across the worker listeners (the primary
+        # accept loop keeps its own share): with 48 conns over 5 sockets,
+        # at least two workers must have accepted directly.
+        assert sum(accepts.values()) > 0
+        assert sum(1 for v in accepts.values() if v > 0) >= 2, accepts
+        for c in conns:
+            c.close()
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_reuseport_off_single_loop_parity():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, io_threads=4, reuseport="off")
+    srv.start()
+    try:
+        assert srv.reuseport is False
+        conns = [
+            MerkleKVClient("127.0.0.1", srv.port).connect()
+            for _ in range(12)
+        ]
+        for c in conns:
+            assert c.ping().startswith("PONG")
+        stats = conns[0].stats()
+        assert stats["io_reuseport"] == "0"
+        # Single accept loop: no worker ever accepts on its own listener,
+        # yet every connection is served (round-robin handoff parity).
+        assert all(v == 0 for v in _worker_accepts(stats).values())
+        for c in conns:
+            c.close()
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_reuseport_admission_control_shared_count():
+    """max_connections holds across BOTH accept paths: the shared atomic
+    count gates worker-listener accepts exactly like the classic loop."""
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, io_threads=2, reuseport="on")
+    srv.start()
+    srv.set_limits(max_connections=4)
+    try:
+        keep = [
+            MerkleKVClient("127.0.0.1", srv.port).connect()
+            for _ in range(4)
+        ]
+        for c in keep:
+            c.ping()
+        refused = 0
+        for _ in range(8):
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            s.settimeout(5)
+            try:
+                data = s.recv(256)
+            except (TimeoutError, OSError):
+                data = b""
+            if b"BUSY connections" in data:
+                refused += 1
+            s.close()
+        assert refused >= 7  # all excess accepts answered BUSY
+        for c in keep:
+            c.close()
+    finally:
+        srv.close()
+        eng.close()
+
+
+# ------------------------------------------ client max_value_bytes fix
+
+
+@pytest.fixture
+def big_value_server():
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, max_line=8 << 20)
+    srv.start()
+    yield srv
+    srv.close()
+    eng.close()
+
+
+def test_sync_client_round_trips_1mib_value(big_value_server):
+    val = "v" * (1 << 20)
+    with MerkleKVClient(
+        "127.0.0.1", big_value_server.port, max_value_bytes=1 << 20
+    ) as c:
+        c.set("big", val)
+        assert c.get("big") == val
+
+
+def test_async_client_round_trips_1mib_value(big_value_server):
+    """The old fixed 1 MiB StreamReader limit made a ~1 MiB GET raise a
+    bare ValueError mid-stream; the limit now sizes from
+    max_value_bytes (default covers exactly this boundary)."""
+    val = "v" * (1 << 20)
+
+    async def run():
+        async with AsyncMerkleKVClient(
+            "127.0.0.1", big_value_server.port
+        ) as c:
+            await c.set("big", val)
+            return await c.get("big")
+
+    assert asyncio.run(run()) == val
+
+
+def test_sync_client_enforces_max_value_bytes(big_value_server):
+    """max_value_bytes bounds the sync reader too (async parity): an
+    oversized VALUE line is refused with a typed ProtocolError naming
+    the knob, not buffered without bound or a bare ValueError."""
+    from merklekv_tpu.client import ConnectionError as MkvConnectionError
+    from merklekv_tpu.client import ProtocolError
+
+    val = "w" * (3 << 20)
+    with MerkleKVClient(
+        "127.0.0.1", big_value_server.port, max_value_bytes=4 << 20
+    ) as writer:
+        writer.set("big3", val)
+        assert writer.get("big3") == val  # large enough limit: fine
+    with MerkleKVClient(
+        "127.0.0.1", big_value_server.port, max_value_bytes=1 << 20
+    ) as reader:
+        with pytest.raises(ProtocolError, match="max_value_bytes"):
+            reader.get("big3")
+        # The stream was mid-value, hence desynchronized: the client must
+        # close rather than serve value bytes as later responses.
+        with pytest.raises(MkvConnectionError, match="not connected"):
+            reader.get("anything")
+
+
+def test_async_client_larger_max_value_bytes(big_value_server):
+    val = "w" * (3 << 20)
+
+    async def run():
+        async with AsyncMerkleKVClient(
+            "127.0.0.1",
+            big_value_server.port,
+            max_value_bytes=4 << 20,
+        ) as c:
+            await c.set("big3", val)
+            return await c.get("big3")
+
+    assert asyncio.run(run()) == val
+
+
+# ------------------------------------------------------ config + metrics
+
+
+def test_server_config_parses_zero_copy_knobs():
+    cfg = Config.from_dict(
+        {
+            "server": {
+                "reuseport": "off",
+                "zero_copy": False,
+                "max_line_bytes": 4 << 20,
+            }
+        }
+    )
+    assert cfg.server.reuseport == "off"
+    assert cfg.server.zero_copy is False
+    assert cfg.server.max_line_bytes == 4 << 20
+    with pytest.raises(ValueError, match="reuseport"):
+        Config.from_dict({"server": {"reuseport": "sometimes"}})
+    with pytest.raises(ValueError, match="max_line_bytes"):
+        Config.from_dict({"server": {"max_line_bytes": -1}})
+
+
+def test_exporter_bridges_slab_and_accept_families():
+    from merklekv_tpu.obs.exporter import render_prometheus
+
+    eng = NativeEngine("mem")
+    srv = NativeServer(eng, "127.0.0.1", 0, io_threads=2, reuseport="auto")
+    srv.start()
+    try:
+        eng.set(b"k", b"v" * 1000)
+        body = render_prometheus(stats_text=srv.stats_text())
+        assert "mkv_native_slab_bytes 1000" in body
+        assert "mkv_native_slab_blocks 1" in body
+        assert "mkv_native_slab_pinned_bytes 0" in body
+        assert "# TYPE mkv_native_serve_zero_copy counter" in body
+        assert "# TYPE mkv_native_slab_alloc_failures counter" in body
+        assert "mkv_native_io_reuseport" in body
+        assert 'mkv_native_io_worker_accepts{worker="0"}' in body
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_top_parses_served_bytes():
+    from merklekv_tpu.obs.top import NodeSample, render_table
+
+    s = NodeSample(node="n1:7379")
+    s.ok = True
+    s.served_bytes = 0
+    prev = NodeSample(node="n1:7379")
+    prev.ok = True
+    out = render_table({"n1:7379": prev}, {"n1:7379": s})
+    assert "SRV_MB/S" in out

@@ -101,6 +101,10 @@ def round_weather(record: dict) -> Optional[str]:
 def lower_is_better(metric: str, unit: str) -> bool:
     """Regression direction for a scenario's primary metric."""
     u = (unit or "").lower()
+    # Per-op cost units (allocs/op, copies/op) carry a "/..." that is NOT
+    # a rate: check them before the throughput rule.
+    if metric.endswith("_per_op") or "/op" in u:
+        return True
     if "/s" in u:
         return False  # throughput: higher is better
     if metric.endswith(("_ms", "_us", "_pct", "_bytes")):
