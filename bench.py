@@ -813,6 +813,173 @@ def bench_many_conn_throughput(
     }
 
 
+def bench_scale_out_throughput(
+    duration_s: float = 1.2, keys_per_partition: int = 2048
+) -> dict:
+    """Horizontal scale-out A/B (ISSUE 15 tentpole evidence).
+
+    Runs the SAME per-node shape — one native server pinned to ONE io
+    worker with the partition guard enforcing its keyspace slice — at 1
+    partition and at 4, and measures aggregate write events/s. The
+    fixed-per-node-resource model is the honest scale-out claim: adding a
+    partition adds one node's worth of serving capacity, so 1 -> 4
+    partitions should scale near-linearly (target >= 3x on CPU).
+
+    Drivers are OUT-OF-PROCESS (one python subprocess per partition,
+    pipelined raw-socket SET bursts over partition-pure keys) so the
+    rig's GIL never caps the aggregate; every driver also scans responses
+    for ERROR — a guard misroute (MOVED) or shed would fail the scenario
+    rather than inflate it. value = the 4-partition aggregate ("/s" reads
+    up-good in tools/bench_gate.py); the 1-partition baseline and the
+    scale factor ride as side fields."""
+    import subprocess
+    import sys as _sys
+
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    driver_src = r"""
+import hashlib, socket, sys, time
+port = int(sys.argv[1]); pid = int(sys.argv[2]); count = int(sys.argv[3])
+n_keys = int(sys.argv[4]); dur = float(sys.argv[5])
+
+def partition_of(key: bytes, count: int) -> int:
+    return int.from_bytes(hashlib.sha256(key).digest()[:8], "big") % count
+
+keys, i = [], 0
+while len(keys) < n_keys:
+    k = b"so:%08d" % i
+    if partition_of(k, count) == pid:
+        keys.append(k)
+    i += 1
+burst_n = 256
+bursts = []
+val = b"v" * 64
+for b in range(4):  # rotate a few distinct bursts so values vary
+    lines = []
+    for j in range(burst_n):
+        k = keys[(b * 131 + j * 17) % n_keys]
+        lines.append(b"SET " + k + b" " + val + b"\r\n")
+    bursts.append(b"".join(lines))
+s = socket.create_connection(("127.0.0.1", port), timeout=30)
+s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+ops = errors = 0
+buf = bytearray(1 << 16)
+carry = b""  # last bytes of the previous chunk: an ERROR reply can
+             # straddle a recv boundary, and the trip-wire must not
+             # miss it (the whole point is an honest rate)
+t0 = time.perf_counter()
+deadline = t0 + dur
+bi = 0
+while time.perf_counter() < deadline:
+    s.sendall(bursts[bi % len(bursts)]); bi += 1
+    got = 0
+    while got < burst_n:
+        n = s.recv_into(buf)
+        if n == 0:
+            raise SystemExit("server closed")
+        got += buf.count(b"\n", 0, n)
+        chunk = bytes(buf[:n])
+        if b"ERROR" in carry + chunk:
+            errors += 1
+        carry = chunk[-4:]
+    ops += burst_n
+elapsed = time.perf_counter() - t0
+s.close()
+print(f"{ops} {elapsed:.6f} {errors}", flush=True)
+"""
+
+    def run(n_parts: int, guard: bool = True) -> tuple[float, int, int]:
+        engines, servers = [], []
+        try:
+            for pid in range(n_parts):
+                eng = NativeEngine("mem")
+                srv = NativeServer(eng, "127.0.0.1", 0, io_threads=1)
+                if guard:
+                    # The guard is ON in BOTH compared shapes (a
+                    # 1-partition cluster is partitioned mode's base
+                    # case), so the scale factor measures SCALING, not
+                    # the per-key SHA-256 routing check — whose cost is
+                    # reported separately via the unpartitioned baseline.
+                    srv.set_partition(1, n_parts, pid)
+                srv.start()
+                engines.append(eng)
+                servers.append(srv)
+            procs = [
+                subprocess.Popen(
+                    [
+                        _sys.executable, "-c", driver_src,
+                        str(servers[pid].port), str(pid), str(n_parts),
+                        str(keys_per_partition), str(duration_s),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                )
+                for pid in range(n_parts)
+            ]
+            # Aggregate rate = sum of per-driver rates over each driver's
+            # OWN active window (interpreter startup and join skew stay
+            # out of the denominator — the drivers run concurrently, and
+            # their windows overlap by construction of the fixed dur).
+            rate = 0.0
+            total_ops = total_errors = 0
+            try:
+                for p in procs:
+                    out, err = p.communicate(timeout=duration_s * 10 + 60)
+                    if p.returncode != 0:
+                        raise RuntimeError(
+                            "scale-out driver failed: "
+                            f"{err.decode()[-400:]}"
+                        )
+                    ops_s, elapsed_s, errors_s = out.split()
+                    total_ops += int(ops_s)
+                    total_errors += int(errors_s)
+                    rate += int(ops_s) / max(float(elapsed_s), 1e-6)
+            finally:
+                # One driver failing must not orphan its siblings (they
+                # would burn CPU into the next scenario's measurements).
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                        p.wait(timeout=10)
+            keys = sum(e.dbsize() for e in engines)
+            if total_errors:
+                raise RuntimeError(
+                    f"scale-out drivers saw {total_errors} ERROR bursts "
+                    "(guard misroute or shed) — rate not trustworthy"
+                )
+            return rate, total_ops, keys
+        finally:
+            for s in servers:
+                s.close()
+            for e in engines:
+                e.close()
+
+    rate1, ops1, keys1 = run(1)
+    rate4, ops4, keys4 = run(4)
+    rate_unpart, _, _ = run(1, guard=False)
+    scale = rate4 / max(rate1, 1e-9)
+    return {
+        "metric": "scale_out_throughput",
+        "value": round(rate4, 1),
+        "unit": "events/s (4 partitions x 1 io worker, pipelined SET)",
+        "partitions": 4,
+        "keys_per_partition": keys_per_partition,
+        "p1_events_per_s": round(rate1, 1),
+        "p4_events_per_s": round(rate4, 1),
+        "p1_keys": keys1,
+        "p4_keys": keys4,
+        # Unguarded single-node baseline: what the per-key SHA-256
+        # routing check costs (the price of MOVED safety, not of scale).
+        "unpartitioned_events_per_s": round(rate_unpart, 1),
+        "guard_overhead_pct": round(
+            100.0 * (1.0 - rate1 / max(rate_unpart, 1e-9)), 1
+        ),
+        "scale_x": round(scale, 2),
+        "target": 3.0,
+        "target_met": scale >= 3.0,
+    }
+
+
 def bench_large_value_throughput(
     n_conns: int = 64, scale: int = 1
 ) -> dict:
@@ -2113,6 +2280,14 @@ def _run(backend: str) -> None:
         )
     except Exception as e:
         print(f"# many_conn_throughput bench failed: {e!r}", file=sys.stderr)
+    try:
+        configs.append(
+            bench_scale_out_throughput(
+                duration_s=2.0 if on_tpu else 1.2
+            )
+        )
+    except Exception as e:
+        print(f"# scale_out_throughput bench failed: {e!r}", file=sys.stderr)
     try:
         configs.append(
             bench_large_value_throughput(scale=4 if on_tpu else 1)

@@ -37,6 +37,13 @@ def main(argv: list[str] | None = None) -> int:
         from merklekv_tpu.obs.blackbox import main as blackbox_main
 
         return blackbox_main(argv[1:])
+    if argv and argv[0] == "router":
+        # Thin partition router: one address dumb clients can point at in
+        # a partitioned cluster (docs/PROTOCOL.md "Partitioned cluster
+        # mode"); smart clients route themselves and skip this hop.
+        from merklekv_tpu.cluster.router import main as router_main
+
+        return router_main(argv[1:])
     if argv and argv[0] == "trace":
         # Cross-node causal-trace assembly: TRACEDUMP from every node,
         # stitched into one Perfetto-loadable Chrome trace

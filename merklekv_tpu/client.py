@@ -17,6 +17,7 @@ import asyncio
 import base64
 import os
 import socket
+import time
 import zlib
 from typing import Iterable, Optional, Sequence
 
@@ -57,6 +58,22 @@ class ReadOnlyError(ProtocolError):
     route writes elsewhere or wait for /healthz to return to live."""
 
 
+class MovedError(ProtocolError):
+    """The node refused a request because the key (or pt=-addressed tree)
+    belongs to a partition it does not own (``ERROR MOVED <partition>
+    <epoch>``): this client — or the router in front of it — routed with
+    a STALE partition map. RETRYABLE after a map refresh: fetch PARTMAP
+    again (the answer's ``epoch`` names the refusing node's map
+    generation) and re-route to the partition's current replica group;
+    :class:`PartitionedClient` does exactly that. Never a silent
+    wrong-node read — the native guard answers this instead of serving."""
+
+    def __init__(self, msg: str, partition: int, epoch: int) -> None:
+        super().__init__(msg)
+        self.partition = partition
+        self.epoch = epoch
+
+
 # --------------------------------------------------------------- parsing
 
 def _parse_simple(resp: str) -> str:
@@ -70,6 +87,17 @@ def _parse_simple(resp: str) -> str:
             raise ServerBusyError(msg)
         if msg.startswith("READONLY"):
             raise ReadOnlyError(msg)
+        if msg.startswith("MOVED"):
+            # "MOVED <partition> <epoch>" — typed so partition-aware
+            # callers can refresh their map and re-route; a malformed
+            # MOVED body stays a plain ProtocolError (never guess a
+            # partition id out of garbage).
+            fields = msg.split(" ")
+            if len(fields) == 3:
+                try:
+                    raise MovedError(msg, int(fields[1]), int(fields[2]))
+                except ValueError:
+                    pass
         raise ProtocolError(msg)
     return resp
 
@@ -139,6 +167,23 @@ def _decode_chunk(
     if zlib.crc32(raw) != crc:
         raise ChunkIntegrityError("chunk crc mismatch")
     return raw
+
+
+def _parse_partmap_header(header: str) -> int:
+    """Row count from a ``PARTMAP <epoch> <count>`` header (shared
+    sync/async). Validated BEFORE any body read so a garbled header can
+    never leave the client waiting out rows that will not come; the full
+    semantic validation happens in ``PartitionMap.from_wire``."""
+    fields = header.split(" ")
+    if len(fields) != 3 or fields[0] != "PARTMAP":
+        raise ProtocolError(f"unexpected response: {header}")
+    try:
+        count = int(fields[2])
+    except ValueError as e:
+        raise ProtocolError(f"malformed PARTMAP header: {header!r}") from e
+    if not 0 < count <= 65536:
+        raise ProtocolError(f"malformed PARTMAP header: {header!r}")
+    return count
 
 
 def _count_after(resp: str, prefix: str) -> int:
@@ -265,6 +310,15 @@ class MerkleKVClient:
         self.version_stamps = False
         self._peer_stamped: Optional[bool] = None
         self.last_stamp: Optional[tuple[int, int]] = None
+        # Partition-scoped tree addressing: when set, HASH and TREELEVEL
+        # carry a trailing "pt=<pid>" token so a partitioned peer can
+        # refuse a stale-map read with ERROR MOVED instead of silently
+        # serving a DIFFERENT partition's tree into this caller's
+        # anti-entropy walk. Deliberately no capability fallback: the
+        # token is only attached by partition-aware callers talking to a
+        # partitioned cluster, and dropping it silently would reopen the
+        # exact wrong-tree hazard it closes.
+        self.partition_id: Optional[int] = None
 
     # -- lifecycle ---------------------------------------------------------
     def connect(self) -> "MerkleKVClient":
@@ -375,6 +429,7 @@ class MerkleKVClient:
         stamp: bool = False,
         force: bool = False,
         trace: bool = True,
+        partition: bool = False,
     ) -> str:
         """Send a cluster verb with the optional trailing tokens appended —
         the version-stamp token (``stamp=True`` verbs only: HASH/TREELEVEL/
@@ -393,9 +448,19 @@ class MerkleKVClient:
         page) instead of erroring. Fixed-arity verbs (TREELEVEL,
         SNAPMETA, SNAPCHUNK) fail closed on extra tokens and settle
         capability safely. ``force`` rides the stamp token (vs=03): ask
-        the server for a fresh tree before answering."""
+        the server for a fresh tree before answering.
+
+        ``partition=True`` verbs (HASH, TREELEVEL) additionally carry the
+        "pt=<pid>" partition address when ``partition_id`` is set — FIRST
+        in the suffix, and exempt from the capability fallback: partition
+        addressing has no silent-downgrade mode (docstring on
+        ``partition_id``)."""
         if stamp:
             self.last_stamp = None
+        if partition and self.partition_id is not None and (
+            self.partition_id >= 0
+        ):
+            line = f"{line} pt={self.partition_id}"
         vtok = self._version_token(require_settled, force) if stamp else None
         ttok = self._trace_token() if trace else None
         if ttok is not None and require_settled and self._peer_traced is not True:
@@ -522,7 +587,7 @@ class MerkleKVClient:
         resp = _parse_simple(
             self._traced_request(
                 "HASH", require_settled=True, stamp=True, force=force,
-                trace=False,
+                trace=False, partition=True,
             )
         )
         fields = resp.split(" ")
@@ -647,7 +712,8 @@ class MerkleKVClient:
         freshly refreshed tree (the walk's staleness escalation)."""
         resp = _parse_simple(
             self._traced_request(
-                f"TREELEVEL {level} {lo} {hi}", stamp=True, force=force
+                f"TREELEVEL {level} {lo} {hi}", stamp=True, force=force,
+                partition=True,
             )
         )
         if not resp.startswith("NODES "):
@@ -678,6 +744,34 @@ class MerkleKVClient:
                 raise ProtocolError(f"malformed TREELEVEL row: {line!r}") from e
             rows.append((idx, hexd))
         return rows, n
+
+    def partition_map(self):
+        """Fetch the node's versioned partition map (PARTMAP extension
+        verb) as a :class:`~merklekv_tpu.cluster.partmap.PartitionMap`.
+        Raises ProtocolError on an unpartitioned (or old) node — the
+        capability signal that this deployment has no partitions — and
+        :class:`~merklekv_tpu.cluster.partmap.PartitionMapError` (a
+        ValueError) on a truncated/garbled dump: routing must never
+        proceed on a partial map."""
+        from merklekv_tpu.cluster.partmap import PartitionMap
+
+        header = _parse_simple(self._request("PARTMAP"))
+        # A garbled header (or missing END) leaves an unknowable number of
+        # body bytes in flight: CLOSE before raising so a caller that
+        # catches the error cannot read leftover rows as later responses
+        # (the PR 14 oversized-value rule). An ERROR answer above and a
+        # from_wire validation failure below are both stream-synchronized
+        # and keep the connection.
+        try:
+            count = _parse_partmap_header(header)
+        except ProtocolError:
+            self.close()
+            raise
+        rows = [self._read_line() for _ in range(count)]
+        if self._read_line() != "END":
+            self.close()
+            raise ProtocolError("PARTMAP body not closed by END")
+        return PartitionMap.from_wire(header, rows)
 
     def snap_meta(self) -> tuple[int, int, int, str]:
         """Newest shippable snapshot on the peer (SNAPMETA): ``(seq,
@@ -900,6 +994,9 @@ class AsyncMerkleKVClient:
         self.version_stamps = False
         self._peer_stamped: Optional[bool] = None
         self.last_stamp: Optional[tuple[int, int]] = None
+        # Partition-scoped tree addressing, mirroring the sync client
+        # (no capability fallback by design — see the sync docstring).
+        self.partition_id: Optional[int] = None
 
     async def connect(self) -> "AsyncMerkleKVClient":
         try:
@@ -945,13 +1042,29 @@ class AsyncMerkleKVClient:
         if self._writer is None:
             raise ConnectionError("not connected")
         payload = line.encode("utf-8") + b"\r\n"
-        self._writer.write(payload)
-        self.bytes_sent += len(payload)
-        await self._writer.drain()
+        try:
+            self._writer.write(payload)
+            self.bytes_sent += len(payload)
+            await self._writer.drain()
+        except OSError as e:
+            # Wrap like the sync client's send path: callers that heal
+            # connection failures (PartitionedClient replica rotation)
+            # match on the module's typed ConnectionError, and a builtin
+            # ConnectionResetError from drain() must not slip past them.
+            raise ConnectionError(f"send failed: {e}") from e
         return await self._read_line()
 
     async def _read_line(self) -> str:
-        raw = await asyncio.wait_for(self._reader.readline(), self.timeout)
+        try:
+            raw = await asyncio.wait_for(
+                self._reader.readline(), self.timeout
+            )
+        except asyncio.TimeoutError as e:
+            # Sync-client parity: a timeout is MerkleKVError, a transport
+            # death is the typed ConnectionError (rotation matches it).
+            raise MerkleKVError(f"timed out after {self.timeout}s") from e
+        except OSError as e:
+            raise ConnectionError(f"recv failed: {e}") from e
         if not raw:
             raise ConnectionError("server closed connection")
         self.bytes_received += len(raw)
@@ -987,13 +1100,19 @@ class AsyncMerkleKVClient:
         stamp: bool = False,
         force: bool = False,
         trace: bool = True,
+        partition: bool = False,
     ) -> str:
         """Async twin of the sync client's ``_traced_request``: same token
-        append (version stamp first, trace last), same newest-capability-
-        first fallback on an arity ERROR, same settled-capability rule for
-        optional-trailing-argument verbs."""
+        append (partition address first, then version stamp, trace last),
+        same newest-capability-first fallback on an arity ERROR, same
+        settled-capability rule for optional-trailing-argument verbs, and
+        the same no-fallback rule for the partition address."""
         if stamp:
             self.last_stamp = None
+        if partition and self.partition_id is not None and (
+            self.partition_id >= 0
+        ):
+            line = f"{line} pt={self.partition_id}"
         vtok = self._version_token(require_settled, force) if stamp else None
         ttok = self._trace_token() if trace else None
         if ttok is not None and require_settled and self._peer_traced is not True:
@@ -1066,7 +1185,7 @@ class AsyncMerkleKVClient:
         resp = _parse_simple(
             await self._traced_request(
                 "HASH", require_settled=True, stamp=True, force=force,
-                trace=False,
+                trace=False, partition=True,
             )
         )
         fields = resp.split(" ")
@@ -1133,7 +1252,8 @@ class AsyncMerkleKVClient:
         ``tree_level`` (stamp in ``last_stamp``, ``force`` refreshes)."""
         resp = _parse_simple(
             await self._traced_request(
-                f"TREELEVEL {level} {lo} {hi}", stamp=True, force=force
+                f"TREELEVEL {level} {lo} {hi}", stamp=True, force=force,
+                partition=True,
             )
         )
         if not resp.startswith("NODES "):
@@ -1161,6 +1281,26 @@ class AsyncMerkleKVClient:
                 raise ProtocolError(f"malformed TREELEVEL row: {line!r}") from e
             rows.append((idx, hexd))
         return rows, n
+
+    async def partition_map(self):
+        """Async PARTMAP — same verify-or-raise semantics as the sync
+        client's ``partition_map``."""
+        from merklekv_tpu.cluster.partmap import PartitionMap
+
+        header = _parse_simple(await self._request("PARTMAP"))
+        # Same stream-desync rule as the sync client: close on a garbled
+        # header or missing END, keep the connection on synchronized
+        # failures (ERROR answer, from_wire validation).
+        try:
+            count = _parse_partmap_header(header)
+        except ProtocolError:
+            await self.close()
+            raise
+        rows = [await self._read_line() for _ in range(count)]
+        if (await self._read_line()) != "END":
+            await self.close()
+            raise ProtocolError("PARTMAP body not closed by END")
+        return PartitionMap.from_wire(header, rows)
 
     async def snap_meta(self) -> tuple[int, int, int, str]:
         """Async SNAPMETA — same semantics as the sync client's
@@ -1276,3 +1416,464 @@ class AsyncMerkleKVClient:
         self.bytes_sent += len(payload)
         await self._writer.drain()
         return [await self._read_line() for _ in cmds]
+
+
+# ------------------------------------------------- partition-aware clients
+
+
+class PartitionedClient:
+    """Smart client for partitioned cluster mode: routes every key to its
+    partition's replica group using the cluster's versioned partition map
+    (docs/PROTOCOL.md "Partitioned cluster mode").
+
+        with PartitionedClient(["host:7001", "host:7003"]) as c:
+            c.set("k", "v")          # lands on partition_of("k")'s group
+            c.mget(["a", "b", "c"])  # fans out per partition, merged
+
+    Bootstraps the map from any ``seeds`` node via PARTMAP. A node
+    answering ``ERROR MOVED <pid> <epoch>`` (this client's map went
+    stale) triggers a map refresh + re-route — bounded by
+    ``moved_retries``, backing off between attempts — so a rebalance is a
+    transient blip, never a silent wrong-node read. A dead replica
+    rotates to its partition siblings.
+
+    One TCP connection per partition, lazily opened, NOT thread-safe
+    (same contract as :class:`MerkleKVClient`).
+    """
+
+    def __init__(
+        self,
+        seeds: Sequence[str],
+        timeout: float = 5.0,
+        max_value_bytes: int = 1 << 20,
+        moved_retries: int = 4,
+    ) -> None:
+        if not seeds:
+            raise ValueError("PartitionedClient needs at least one seed")
+        self.seeds = list(seeds)
+        self.timeout = timeout
+        self.max_value_bytes = max_value_bytes
+        self.moved_retries = moved_retries
+        self._map = None  # PartitionMap
+        self._conns: dict[int, MerkleKVClient] = {}
+        self._replica_idx: dict[int, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect(self) -> "PartitionedClient":
+        self.refresh_map()
+        return self
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()
+
+    def __enter__(self) -> "PartitionedClient":
+        if self._map is None:
+            self.connect()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def map(self):
+        """The PartitionMap currently routing (None before connect)."""
+        return self._map
+
+    @property
+    def epoch(self) -> int:
+        return self._map.epoch if self._map is not None else 0
+
+    # -- map management ----------------------------------------------------
+    def refresh_map(self, min_epoch: int = 0) -> None:
+        """Fetch the newest partition map reachable: seeds first, then
+        every replica the current map names. Stops early at a map with
+        ``epoch >= min_epoch`` (the epoch a MOVED answer carried);
+        otherwise keeps the newest epoch seen. Raises ConnectionError when
+        no candidate serves a valid map."""
+        candidates: list[str] = list(self.seeds)
+        if self._map is not None:
+            for reps in self._map.replicas:
+                for a in reps:
+                    if a not in candidates:
+                        candidates.append(a)
+        best = None
+        errors: list[str] = []
+        for addr in candidates:
+            host, _, port = addr.rpartition(":")
+            try:
+                with MerkleKVClient(
+                    host, int(port), timeout=self.timeout
+                ) as c:
+                    m = c.partition_map()
+            except (MerkleKVError, ValueError) as e:
+                errors.append(f"{addr}: {e}")
+                continue
+            if best is None or m.epoch > best.epoch:
+                best = m
+            if best.epoch >= min_epoch > 0:
+                break
+        if best is None:
+            raise ConnectionError(
+                "no reachable node served a partition map: "
+                + "; ".join(errors[:4])
+            )
+        if self._map is None or best.epoch >= self._map.epoch:
+            if (
+                self._map is not None
+                and best.count != self._map.count
+            ):
+                # A partition-count change remaps every key: drop all
+                # cached connections, not just the refused one.
+                self.close()
+            self._map = best
+
+    def _drop(self, pid: int, rotate: bool = False) -> None:
+        c = self._conns.pop(pid, None)
+        if c is not None:
+            c.close()
+        if rotate:
+            self._replica_idx[pid] = self._replica_idx.get(pid, 0) + 1
+
+    def _client(self, pid: int) -> MerkleKVClient:
+        c = self._conns.get(pid)
+        if c is not None:
+            return c
+        if not 0 <= pid < self._map.count:
+            # A refresh shrank the map after this operation resolved its
+            # partition: surface the typed routing error (the _routed
+            # retry refreshes and re-resolves) — never a raw IndexError.
+            raise MovedError(
+                f"MOVED {pid} {self._map.epoch}", pid, self._map.epoch
+            )
+        reps = self._map.replicas[pid]
+        start = self._replica_idx.get(pid, 0)
+        last: Optional[Exception] = None
+        for i in range(len(reps)):
+            addr = reps[(start + i) % len(reps)]
+            host, _, port = addr.rpartition(":")
+            try:
+                c = MerkleKVClient(
+                    host,
+                    int(port),
+                    timeout=self.timeout,
+                    max_value_bytes=self.max_value_bytes,
+                ).connect()
+            except ConnectionError as e:
+                last = e
+                continue
+            self._replica_idx[pid] = (start + i) % len(reps)
+            self._conns[pid] = c
+            return c
+        raise ConnectionError(
+            f"no reachable replica for partition {pid}: {last}"
+        )
+
+    def _routed(self, pid_of, fn):
+        """THE routing-retry loop (every single-partition operation rides
+        it): resolve the partition — re-resolved each attempt, a
+        refreshed map may re-home the work — run ``fn(client, pid)``
+        against its connection, and heal routing failures: MOVED
+        refreshes the map (at least to the refusing node's epoch) and
+        re-routes; a dead connection rotates to the next replica. Bounded
+        by ``moved_retries`` with backoff."""
+        if self._map is None:
+            self.refresh_map()
+        last: Optional[Exception] = None
+        for attempt in range(max(1, self.moved_retries)):
+            if attempt:
+                time.sleep(min(0.05 * (2 ** (attempt - 1)), 0.5))
+            pid = pid_of()
+            try:
+                return fn(self._client(pid), pid)
+            except MovedError as e:
+                last = e
+                self._drop(pid)
+                try:
+                    self.refresh_map(min_epoch=e.epoch)
+                except ConnectionError as re:
+                    last = re
+            except ConnectionError as e:
+                last = e
+                self._drop(pid, rotate=True)
+        raise last  # type: ignore[misc]
+
+    def _run(self, key: str, fn):
+        """Route one single-key operation through the shared retry loop."""
+        return self._routed(
+            lambda: self._map.partition_for_key(key),
+            lambda c, _pid: fn(c),
+        )
+
+    def _run_grouped(self, keys: Sequence[str], fn):
+        """Fan a multi-key operation out per partition and merge: ``fn``
+        receives (client, keys-subset) per touched partition. The whole
+        operation retries on MOVED/connection failure — regrouped under
+        the refreshed map."""
+        if self._map is None:
+            self.refresh_map()
+        last: Optional[Exception] = None
+        for attempt in range(max(1, self.moved_retries)):
+            if attempt:
+                time.sleep(min(0.05 * (2 ** (attempt - 1)), 0.5))
+            groups: dict[int, list[str]] = {}
+            for k in keys:
+                groups.setdefault(self._map.partition_for_key(k), []).append(k)
+            out = []
+            try:
+                for pid, sub in sorted(groups.items()):
+                    out.append((sub, fn(self._client(pid), sub)))
+                return out
+            except MovedError as e:
+                last = e
+                self.close()
+                try:
+                    self.refresh_map(min_epoch=e.epoch)
+                except ConnectionError as re:
+                    last = re
+            except ConnectionError as e:
+                last = e
+                self.close()
+        raise last  # type: ignore[misc]
+
+    # -- data plane --------------------------------------------------------
+    def get(self, key: str) -> Optional[str]:
+        return self._run(key, lambda c: c.get(key))
+
+    def set(self, key: str, value: str) -> bool:
+        return self._run(key, lambda c: c.set(key, value))
+
+    def delete(self, key: str) -> bool:
+        return self._run(key, lambda c: c.delete(key))
+
+    def increment(self, key: str, amount: Optional[int] = None) -> int:
+        return self._run(key, lambda c: c.increment(key, amount))
+
+    def decrement(self, key: str, amount: Optional[int] = None) -> int:
+        return self._run(key, lambda c: c.decrement(key, amount))
+
+    def append(self, key: str, value: str) -> str:
+        return self._run(key, lambda c: c.append(key, value))
+
+    def prepend(self, key: str, value: str) -> str:
+        return self._run(key, lambda c: c.prepend(key, value))
+
+    def exists(self, *keys: str) -> int:
+        return sum(
+            n for _, n in self._run_grouped(keys, lambda c, ks: c.exists(*ks))
+        )
+
+    def mget(self, keys: Sequence[str]) -> dict[str, Optional[str]]:
+        out: dict[str, Optional[str]] = {}
+        for _, part in self._run_grouped(keys, lambda c, ks: c.mget(ks)):
+            out.update(part)
+        return out
+
+    def mset(self, pairs: dict[str, str]) -> bool:
+        keys = list(pairs)
+        self._run_grouped(
+            keys, lambda c, ks: c.mset({k: pairs[k] for k in ks})
+        )
+        return True
+
+    # -- partition-scoped tree plane ---------------------------------------
+    def partition_root(self, pid: int, force: bool = False) -> str:
+        """Merkle root of ONE partition, served pt=-addressed by a member
+        of its replica group — a wrong-partition answer comes back MOVED,
+        never as a silently different tree."""
+        if self._map is None:
+            self.refresh_map()
+        if not 0 <= pid < self._map.count:
+            raise ValueError(f"partition {pid} out of range")
+
+        def op(c: MerkleKVClient, p: int) -> str:
+            c.partition_id = p
+            return c.hash(force=force)
+
+        return self._routed(lambda: pid, op)
+
+    def partition_roots(self, force: bool = False) -> dict[int, str]:
+        """Per-partition Merkle roots across the whole cluster — the
+        health surface a partition-local incident shows up in (one
+        partition's root diverges, siblings' stay put)."""
+        if self._map is None:
+            self.refresh_map()
+        return {
+            pid: self.partition_root(pid, force=force)
+            for pid in range(self._map.count)
+        }
+
+
+class AsyncPartitionedClient:
+    """asyncio twin of :class:`PartitionedClient` over the async base
+    client's surface (get/set/delete/increment): same map bootstrap from
+    seeds, same MOVED -> refresh -> re-route healing, same replica
+    rotation on a dead connection."""
+
+    def __init__(
+        self,
+        seeds: Sequence[str],
+        timeout: float = 5.0,
+        max_value_bytes: int = 1 << 20,
+        moved_retries: int = 4,
+    ) -> None:
+        if not seeds:
+            raise ValueError("AsyncPartitionedClient needs at least one seed")
+        self.seeds = list(seeds)
+        self.timeout = timeout
+        self.max_value_bytes = max_value_bytes
+        self.moved_retries = moved_retries
+        self._map = None
+        self._conns: dict[int, AsyncMerkleKVClient] = {}
+        self._replica_idx: dict[int, int] = {}
+
+    async def connect(self) -> "AsyncPartitionedClient":
+        await self.refresh_map()
+        return self
+
+    async def close(self) -> None:
+        for c in self._conns.values():
+            await c.close()
+        self._conns.clear()
+
+    async def __aenter__(self) -> "AsyncPartitionedClient":
+        if self._map is None:
+            await self.connect()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    @property
+    def map(self):
+        return self._map
+
+    @property
+    def epoch(self) -> int:
+        return self._map.epoch if self._map is not None else 0
+
+    async def refresh_map(self, min_epoch: int = 0) -> None:
+        candidates: list[str] = list(self.seeds)
+        if self._map is not None:
+            for reps in self._map.replicas:
+                for a in reps:
+                    if a not in candidates:
+                        candidates.append(a)
+        best = None
+        errors: list[str] = []
+        for addr in candidates:
+            host, _, port = addr.rpartition(":")
+            try:
+                async with AsyncMerkleKVClient(
+                    host, int(port), timeout=self.timeout
+                ) as c:
+                    m = await c.partition_map()
+            except (MerkleKVError, ValueError, asyncio.TimeoutError) as e:
+                errors.append(f"{addr}: {e}")
+                continue
+            if best is None or m.epoch > best.epoch:
+                best = m
+            if best.epoch >= min_epoch > 0:
+                break
+        if best is None:
+            raise ConnectionError(
+                "no reachable node served a partition map: "
+                + "; ".join(errors[:4])
+            )
+        if self._map is None or best.epoch >= self._map.epoch:
+            if self._map is not None and best.count != self._map.count:
+                await self.close()
+            self._map = best
+
+    async def _drop(self, pid: int, rotate: bool = False) -> None:
+        c = self._conns.pop(pid, None)
+        if c is not None:
+            await c.close()
+        if rotate:
+            self._replica_idx[pid] = self._replica_idx.get(pid, 0) + 1
+
+    async def _client(self, pid: int) -> AsyncMerkleKVClient:
+        c = self._conns.get(pid)
+        if c is not None:
+            return c
+        if not 0 <= pid < self._map.count:
+            # Same shrunk-map rule as the sync client's _client.
+            raise MovedError(
+                f"MOVED {pid} {self._map.epoch}", pid, self._map.epoch
+            )
+        reps = self._map.replicas[pid]
+        start = self._replica_idx.get(pid, 0)
+        last: Optional[Exception] = None
+        for i in range(len(reps)):
+            addr = reps[(start + i) % len(reps)]
+            host, _, port = addr.rpartition(":")
+            try:
+                c = await AsyncMerkleKVClient(
+                    host,
+                    int(port),
+                    timeout=self.timeout,
+                    max_value_bytes=self.max_value_bytes,
+                ).connect()
+            except ConnectionError as e:
+                last = e
+                continue
+            self._replica_idx[pid] = (start + i) % len(reps)
+            self._conns[pid] = c
+            return c
+        raise ConnectionError(
+            f"no reachable replica for partition {pid}: {last}"
+        )
+
+    async def _routed(self, pid_of, fn):
+        """Async twin of the sync client's ``_routed`` retry loop."""
+        if self._map is None:
+            await self.refresh_map()
+        last: Optional[Exception] = None
+        for attempt in range(max(1, self.moved_retries)):
+            if attempt:
+                await asyncio.sleep(min(0.05 * (2 ** (attempt - 1)), 0.5))
+            pid = pid_of()
+            try:
+                return await fn(await self._client(pid), pid)
+            except MovedError as e:
+                last = e
+                await self._drop(pid)
+                try:
+                    await self.refresh_map(min_epoch=e.epoch)
+                except ConnectionError as re:
+                    last = re
+            except ConnectionError as e:
+                last = e
+                await self._drop(pid, rotate=True)
+        raise last  # type: ignore[misc]
+
+    async def _run(self, key: str, fn):
+        return await self._routed(
+            lambda: self._map.partition_for_key(key),
+            lambda c, _pid: fn(c),
+        )
+
+    async def get(self, key: str) -> Optional[str]:
+        return await self._run(key, lambda c: c.get(key))
+
+    async def set(self, key: str, value: str) -> bool:
+        return await self._run(key, lambda c: c.set(key, value))
+
+    async def delete(self, key: str) -> bool:
+        return await self._run(key, lambda c: c.delete(key))
+
+    async def increment(self, key: str, amount: Optional[int] = None) -> int:
+        return await self._run(key, lambda c: c.increment(key, amount))
+
+    async def partition_root(self, pid: int, force: bool = False) -> str:
+        if self._map is None:
+            await self.refresh_map()
+        if not 0 <= pid < self._map.count:
+            raise ValueError(f"partition {pid} out of range")
+
+        async def op(c: AsyncMerkleKVClient, p: int) -> str:
+            c.partition_id = p
+            return await c.hash(force=force)
+
+        return await self._routed(lambda: pid, op)

@@ -76,6 +76,64 @@ class ClusterNode:
         # disk watermark monitor and enforced by the native server.
         self.ladder = DegradationLadder()
         self._overload: Optional[OverloadMonitor] = None
+        # Partitioned cluster mode: this node owns ONE partition of a
+        # P-way keyspace. The map (validated here, served via PARTMAP) is
+        # the routing table; the native guard refuses foreign keys with
+        # ERROR MOVED; replication rides a partition-local topic; and the
+        # anti-entropy peer set defaults to the partition's sibling
+        # replicas — so failures, overload, repair, and bootstrap all stay
+        # partition-local by construction (the node's whole-keyspace root
+        # IS the per-partition Merkle root).
+        self._partmap = None
+        self._partition_id: Optional[int] = None
+        if cfg.cluster.partitions > 0:
+            from merklekv_tpu.cluster.partmap import parse_map_spec
+
+            if not 0 <= cfg.cluster.partition_id < cfg.cluster.partitions:
+                # Config.from_dict validates TOML-loaded configs; a
+                # programmatically built Config bypasses it, and the
+                # default partition_id of -1 would silently derive peers
+                # from replicas[-1] (the LAST partition) while the native
+                # guard clamps to 0 — a loud startup error beats a node
+                # enforcing one partition while syncing against another.
+                raise ValueError(
+                    "[cluster] partition_id must be in "
+                    f"[0, {cfg.cluster.partitions}), got "
+                    f"{cfg.cluster.partition_id}"
+                )
+            self._partmap = parse_map_spec(
+                cfg.cluster.partition_map,
+                cfg.cluster.partitions,
+                cfg.cluster.map_epoch,
+            )
+            self._partition_id = cfg.cluster.partition_id
+            if not cfg.anti_entropy.peers and cfg.port:
+                # Sibling derivation: the partition's other replicas are
+                # exactly the peers anti-entropy (and bootstrap donors)
+                # should talk to — cross-partition walks would compare
+                # DISJOINT keyspaces and mirror everything as divergence.
+                # An explicit [anti_entropy] peers list still wins; nodes
+                # on an ephemeral port (tests) cannot self-identify and
+                # keep their explicit list.
+                def is_self(a: str) -> bool:
+                    # Exact-match plus the wildcard-bind case: a node
+                    # bound 0.0.0.0/:: cannot know which map spelling is
+                    # its own, so same-port entries are treated as self —
+                    # a node must never dial itself as a peer. Exotic
+                    # host spellings (localhost vs 127.0.0.1) should set
+                    # [anti_entropy] peers explicitly.
+                    host, _, port = a.rpartition(":")
+                    if port != str(cfg.port):
+                        return False
+                    return host == cfg.host or cfg.host in (
+                        "0.0.0.0", "::", ""
+                    )
+
+                cfg.anti_entropy.peers = [
+                    a
+                    for a in self._partmap.replicas[self._partition_id]
+                    if not is_self(a)
+                ]
         self.sync_manager = SyncManager(
             engine,
             device=cfg.anti_entropy.engine,
@@ -86,11 +144,21 @@ class ClusterNode:
             on_cycle_converged=self.lag_tracker.on_converged,
             max_skew_ms=cfg.replication.max_skew_ms,
             tree_lag_limit=cfg.device.max_staleness_versions,
+            partition_id=self._partition_id,
         )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._server.set_cluster_handler(self._on_cluster_command)
+        # Partition guard BEFORE anything serves: from the first accepted
+        # command, a foreign key answers ERROR MOVED instead of landing in
+        # (and polluting) this partition's keyspace.
+        if self._partmap is not None:
+            self._server.set_partition(
+                self._partmap.epoch,
+                self._partmap.count,
+                self._partition_id,
+            )
         # Overload protection BEFORE anything serves: admission limits go
         # to the native accept path, and the watermark monitor starts
         # pushing the degradation ladder (its first poll runs inline, so
@@ -104,6 +172,7 @@ class ClusterNode:
             self._server,
             self._cfg.server,
             storage=self._storage,
+            partition_id=self._partition_id,
         ).start()
         if self._storage is not None:
             self._storage.set_defer_compaction(self._overload.memory_pressure)
@@ -204,7 +273,23 @@ class ClusterNode:
         rec = flightrec.get_recorder()
         rec.set_capacity(obs.flight_events)
         self._server.set_slow_threshold(obs.slow_command_us)
-        rec.record("node_start", port=self._server.port)
+        if self._partition_id is not None:
+            # The partition id on node_start is what lets blackbox group
+            # several nodes' spills by partition and tell a partition-
+            # local incident (one group flips) from a cluster-wide one.
+            rec.record(
+                "node_start",
+                port=self._server.port,
+                partition=self._partition_id,
+            )
+            rec.record(
+                "map_change",
+                epoch=self._partmap.epoch,
+                partitions=self._partmap.count,
+                partition=self._partition_id,
+            )
+        else:
+            rec.record("node_start", port=self._server.port)
         self._flight_sampler = flightrec.MetricSampler(
             interval_s=obs.flight_sample_s,
             stats_fn=self._server.stats_text,
@@ -282,6 +367,11 @@ class ClusterNode:
         # a node stops (the process-level path closes it right after, so
         # the draining window there lasts until server.close()).
         self._server.set_degradation(0, 0)
+        if self._partmap is not None:
+            # Same successor-node rule as the slow threshold below: an
+            # embedded server reused by an unpartitioned node must not
+            # keep refusing foreign keys with a dead node's map.
+            self._server.set_partition(0, 0, 0)
         # Disarm the slow-command log with the rest of the per-node server
         # state: a successor node attached to the same embedded server
         # must not inherit this node's threshold.
@@ -356,13 +446,28 @@ class ClusterNode:
                 # writes reach the WAL through its batch listener, remote
                 # applies through the storage hook inside the replicator.
                 storage.pause_drain()
+            # Partition-local replication fabric: each partition's replica
+            # group publishes/subscribes on its OWN topic, so one
+            # partition's write storm (or poisoned stream) can never fan
+            # out into a sibling partition's appliers — the frame-level
+            # blast radius is one partition. The node id carries a p<pid>
+            # prefix so per-peer attribution (replication.lag_events.<src>,
+            # skew clamps, blackbox joins) names the partition too.
+            topic_prefix = self._cfg.replication.topic_prefix
+            node_id = self._cfg.replication.client_id
+            if self._partition_id is not None:
+                topic_prefix = f"{topic_prefix}/p{self._partition_id}"
+                node_id = node_id or (
+                    f"p{self._partition_id}-"
+                    f"{self._cfg.host}:{self._server.port}"
+                )
             try:
                 self._replicator = Replicator(
                     self._engine,
                     self._server,
                     transport,
-                    topic_prefix=self._cfg.replication.topic_prefix,
-                    node_id=self._cfg.replication.client_id,
+                    topic_prefix=topic_prefix,
+                    node_id=node_id,
                     mirror=self._mirror,
                     batch_listener=(
                         storage.record_events if storage is not None else None
@@ -717,6 +822,14 @@ class ClusterNode:
         payload["degradation"] = LEVEL_NAMES.get(level, "live")
         if level >= SHEDDING:
             payload["status"] = "degraded"
+        if self._partition_id is not None:
+            # Per-partition readiness: this node IS one replica of one
+            # partition, so its rung is that partition's health here —
+            # an LB/router reading every replica's /healthz gets the
+            # per-partition availability matrix.
+            payload["partition"] = self._partition_id
+            payload["partition_epoch"] = self._partmap.epoch
+            payload["partition_state"] = LEVEL_NAMES.get(level, "live")
         lag = self.lag_tracker.lag_events()
         if lag:
             payload["lag_events"] = sum(lag.values())
@@ -851,6 +964,22 @@ class ClusterNode:
              "Overload degradation ladder (0=live 1=shedding 2=read_only "
              "3=draining).", ""),
         ]
+        if self._partition_id is not None:
+            pid = str(self._partition_id)
+            ladder = self.ladder
+
+            def partition_state() -> dict:
+                # Labeled by partition so a fleet-wide scrape aggregates
+                # into the per-partition availability matrix directly
+                # (max by partition = worst replica's rung).
+                return {pid: ladder.level()}
+
+            gauges.append(
+                ("partition.state", partition_state,
+                 "Degradation rung of this replica's partition (0=live "
+                 "1=shedding 2=read_only 3=draining), labeled with the "
+                 "partition id it serves.", "partition")
+            )
         if self._storage is not None:
             storage = self._storage
             gauges += [
@@ -952,6 +1081,15 @@ class ClusterNode:
                     )
             except Exception:
                 pass  # a dying mirror drops its lines, not METRICS
+        # Partition plane: identity + state lines so wire-only consumers
+        # (top's PART column, the chaos suite) see which partition this
+        # node serves and how it is doing, without scraping /metrics.
+        # Integer-text contract like every METRICS line.
+        if self._partition_id is not None:
+            lines.append(f"partition.id:{self._partition_id}")
+            lines.append(f"partition.epoch:{self._partmap.epoch}")
+            lines.append(f"partition.count:{self._partmap.count}")
+            lines.append(f"partition.state:{self.ladder.level()}")
         # Overload plane: the ladder rung plus the native shed counters
         # (one stats_text read), so wire-only consumers (top's STATE and
         # SHED/s columns) see overload state without scraping /metrics.
@@ -1010,6 +1148,14 @@ class ClusterNode:
             if self._health is None:
                 return None  # native default: empty table
             return self._health.wire_table()
+        if parts[0] == "PARTMAP":
+            # Versioned partition map: any member serves the full routing
+            # table (smart clients/routers bootstrap from whichever node
+            # they can reach). None on an unpartitioned node -> the native
+            # fallback answers ERROR (capability signal).
+            if self._partmap is None:
+                return None
+            return self._partmap.wire()
         if parts[0] == "METRICS":
             return self._metrics_wire()
         if parts[0] == "TRACE":

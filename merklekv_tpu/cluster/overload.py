@@ -132,12 +132,19 @@ class OverloadMonitor:
         server_cfg,  # config.ServerConfig
         storage=None,  # Optional[DurableStore]
         interval: Optional[float] = None,
+        partition_id: Optional[int] = None,
     ) -> None:
         self._ladder = ladder
         self._engine = engine
         self._server = server
         self._cfg = server_cfg
         self._storage = storage
+        # Partitioned cluster mode: ladder flips additionally record
+        # partition_degraded / partition_healed flight events naming THIS
+        # node's partition — the blackbox signal that an incident is
+        # partition-local (one partition's replicas flip) rather than
+        # cluster-wide (every partition flips at once).
+        self._partition_id = partition_id
         self._interval = (
             interval
             if interval is not None
@@ -217,6 +224,25 @@ class OverloadMonitor:
                     new=LEVEL_NAMES.get(level, level),
                     reason=reason,
                 )
+                if self._partition_id is not None:
+                    # Partition-scoped view of the same flip: leaving live
+                    # degrades ONE partition's capacity, returning heals
+                    # it. Boundary crossings only — rung-to-rung moves
+                    # while already degraded stay "degradation" events.
+                    if prev == LIVE and level > LIVE:
+                        get_metrics().inc("partition.degraded_total")
+                        record(
+                            "partition_degraded",
+                            partition=self._partition_id,
+                            level=LEVEL_NAMES.get(level, level),
+                            reason=reason,
+                        )
+                    elif prev > LIVE and level == LIVE:
+                        get_metrics().inc("partition.healed_total")
+                        record(
+                            "partition_healed",
+                            partition=self._partition_id,
+                        )
                 print(
                     f"overload: {LEVEL_NAMES.get(prev, prev)} -> "
                     f"{LEVEL_NAMES.get(level, level)}"

@@ -1,0 +1,201 @@
+"""Versioned partition map: the routing table of partitioned cluster mode.
+
+The keyspace is hashed into ``P`` partitions, each owned by its own
+replica group (disjoint nodes, own replication topic, own per-partition
+Merkle root — a replica holds ONLY its partition's keys, so its
+whole-node root IS the partition root and anti-entropy, bootstrap,
+overload and the staleness pump stay partition-local by construction).
+
+The map is (epoch, partition -> replica list). Nodes serve it over the
+``PARTMAP`` wire verb; smart clients and the thin router bootstrap from
+any node and refresh whenever a node answers ``ERROR MOVED <pid>
+<epoch>`` (the native guard's stale-routing refusal). The epoch is a
+generation counter: rebalancing installs a new map with a bumped epoch,
+and a MOVED answer carrying a newer epoch is the client's refresh signal.
+
+``partition_of`` MUST stay bit-identical to the native guard
+(server.cc::partition_of_key): first 8 bytes of SHA-256(key), big-endian,
+mod P. Every router, client, bench driver, and the guard route with this
+one function or MOVED ping-pongs forever.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+__all__ = [
+    "partition_of",
+    "PartitionMap",
+    "parse_map_spec",
+    "PartitionMapError",
+]
+
+
+class PartitionMapError(ValueError):
+    """A partition map (wire dump or config spec) failed validation —
+    wrong shape, missing partitions, out-of-range ids, malformed replica
+    addresses. Raised instead of ever returning a PARTIAL map: routing on
+    a half-parsed table is the silent-wrong-node bug the MOVED guard
+    exists to kill."""
+
+
+def partition_of(key: bytes | str, count: int) -> int:
+    """key -> partition id (stable hash partitioning).
+
+    First 8 bytes of SHA-256(key) as a big-endian u64, mod ``count`` —
+    bit-identical to the native dispatch guard (server.cc)."""
+    if isinstance(key, str):
+        key = key.encode("utf-8", "surrogateescape")
+    if count <= 0:
+        raise ValueError(f"partition count must be positive, got {count}")
+    return int.from_bytes(hashlib.sha256(key).digest()[:8], "big") % count
+
+
+def _check_addr(addr: str) -> str:
+    host, sep, port = addr.rpartition(":")
+    if not sep or not host:
+        raise PartitionMapError(f"replica address needs host:port: {addr!r}")
+    try:
+        p = int(port)
+    except ValueError:
+        raise PartitionMapError(
+            f"replica address needs a numeric port: {addr!r}"
+        ) from None
+    if not 0 < p <= 65535:
+        raise PartitionMapError(f"replica port out of range: {addr!r}")
+    return addr
+
+
+@dataclass
+class PartitionMap:
+    """Epoch-versioned partition -> replica-set table."""
+
+    epoch: int = 1
+    # replicas[pid] = ["host:port", ...] — index IS the partition id.
+    replicas: list[list[str]] = field(default_factory=list)
+
+    @property
+    def count(self) -> int:
+        return len(self.replicas)
+
+    def validate(self) -> "PartitionMap":
+        if self.epoch < 1:
+            raise PartitionMapError(f"epoch must be >= 1, got {self.epoch}")
+        if not self.replicas:
+            raise PartitionMapError("partition map has no partitions")
+        for pid, reps in enumerate(self.replicas):
+            if not reps:
+                raise PartitionMapError(f"partition {pid} has no replicas")
+            for addr in reps:
+                _check_addr(addr)
+        return self
+
+    def partition_for_key(self, key: bytes | str) -> int:
+        return partition_of(key, self.count)
+
+    def replicas_for_key(self, key: bytes | str) -> list[str]:
+        return self.replicas[self.partition_for_key(key)]
+
+    def partition_of_replica(self, addr: str) -> int | None:
+        """The partition a replica address serves, or None when the
+        address is not in the map."""
+        for pid, reps in enumerate(self.replicas):
+            if addr in reps:
+                return pid
+        return None
+
+    # -- wire ---------------------------------------------------------------
+    # "PARTMAP <epoch> <count>" header, one "<pid> <replica> [...]" row per
+    # partition (every pid 0..count-1 exactly once, any order), "END".
+    def wire(self) -> str:
+        body = "".join(
+            f"{pid} {' '.join(reps)}\r\n"
+            for pid, reps in enumerate(self.replicas)
+        )
+        return f"PARTMAP {self.epoch} {self.count}\r\n{body}END\r\n"
+
+    @classmethod
+    def from_wire(cls, header: str, rows: list[str]) -> "PartitionMap":
+        """Parse a PARTMAP response (header line + body rows, END already
+        stripped). Every malformation raises :class:`PartitionMapError` —
+        truncated or garbled dumps must never yield a partial map."""
+        fields = header.split(" ")
+        if len(fields) != 3 or fields[0] != "PARTMAP":
+            raise PartitionMapError(f"malformed PARTMAP header: {header!r}")
+        try:
+            epoch, count = int(fields[1]), int(fields[2])
+        except ValueError:
+            raise PartitionMapError(
+                f"malformed PARTMAP header: {header!r}"
+            ) from None
+        if epoch < 1 or count < 1:
+            raise PartitionMapError(f"malformed PARTMAP header: {header!r}")
+        if len(rows) != count:
+            raise PartitionMapError(
+                f"PARTMAP row count mismatch: header says {count}, "
+                f"got {len(rows)}"
+            )
+        replicas: list[list[str] | None] = [None] * count
+        for row in rows:
+            parts = [p for p in row.split(" ") if p]
+            if len(parts) < 2:
+                raise PartitionMapError(f"malformed PARTMAP row: {row!r}")
+            try:
+                pid = int(parts[0])
+            except ValueError:
+                raise PartitionMapError(
+                    f"malformed PARTMAP row: {row!r}"
+                ) from None
+            if not 0 <= pid < count:
+                raise PartitionMapError(
+                    f"PARTMAP row partition {pid} out of range 0..{count - 1}"
+                )
+            if replicas[pid] is not None:
+                raise PartitionMapError(f"duplicate PARTMAP row for {pid}")
+            replicas[pid] = [_check_addr(a) for a in parts[1:]]
+        # len(rows) == count and no duplicates => every slot filled.
+        return cls(epoch=epoch, replicas=[r for r in replicas if r is not None]).validate()
+
+
+def parse_map_spec(spec: str, count: int, epoch: int = 1) -> PartitionMap:
+    """Parse the ``[cluster] partition_map`` config spec:
+    ``"0=host:port,host:port;1=host:port;..."`` — one ``pid=replicas``
+    group per partition, ``;``-separated, replicas ``,``-separated. Every
+    partition 0..count-1 must appear exactly once."""
+    replicas: list[list[str] | None] = [None] * count
+    for group in spec.split(";"):
+        group = group.strip()
+        if not group:
+            continue
+        pid_s, sep, reps_s = group.partition("=")
+        if not sep:
+            raise PartitionMapError(
+                f"partition_map group needs pid=replicas: {group!r}"
+            )
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            raise PartitionMapError(
+                f"partition_map group needs a numeric pid: {group!r}"
+            ) from None
+        if not 0 <= pid < count:
+            raise PartitionMapError(
+                f"partition_map pid {pid} out of range 0..{count - 1}"
+            )
+        if replicas[pid] is not None:
+            raise PartitionMapError(f"duplicate partition_map group for {pid}")
+        reps = [r.strip() for r in reps_s.split(",") if r.strip()]
+        if not reps:
+            raise PartitionMapError(
+                f"partition_map partition {pid} has no replicas"
+            )
+        replicas[pid] = [_check_addr(a) for a in reps]
+    missing = [i for i, r in enumerate(replicas) if r is None]
+    if missing:
+        raise PartitionMapError(
+            f"partition_map missing partitions: {missing}"
+        )
+    return PartitionMap(
+        epoch=epoch, replicas=[r for r in replicas if r is not None]
+    ).validate()

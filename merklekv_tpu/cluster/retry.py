@@ -42,7 +42,9 @@ __all__ = [
     "SERVER_BUSY",
     "DEVICE_DISPATCH",
     "DEVICE_HEAL",
+    "PARTITION_MOVED",
     "RETRYABLE_ERRORS",
+    "ROUTED_RETRYABLE_ERRORS",
 ]
 
 T = TypeVar("T")
@@ -230,12 +232,31 @@ SERVER_BUSY = RetryPolicy(
     first_delay=0.1, max_delay=1.0, jitter=0.3, attempts=6, op_timeout=5.0
 )
 
+# Stale partition map (ERROR MOVED -> client.MovedError): retry AFTER a
+# map refresh + re-route, near-immediately — the condition heals the
+# moment the fresh map arrives, and a handful of attempts bounds a
+# cluster mid-rebalance. A caller that keeps getting MOVED past these
+# attempts holds a map no reachable node agrees with — surface it.
+# PartitionedClient implements this loop internally; use the policy for
+# hand-rolled partition-aware callers.
+PARTITION_MOVED = RetryPolicy(
+    first_delay=0.05, max_delay=0.5, jitter=0.2, attempts=4, op_timeout=5.0
+)
+
 
 # The classification retry-driven callers pass as ``retry_on``: transient
 # transport failures AND the server's explicit shed answer. ReadOnlyError
 # is deliberately absent (see SERVER_BUSY above) — a read-only node asked
 # callers to WAIT, not to hammer it.
-from merklekv_tpu.client import ServerBusyError  # noqa: E402 (no cycle:
-# client.py imports nothing from this package)
+from merklekv_tpu.client import MovedError, ServerBusyError  # noqa: E402
+# (no cycle: client.py only lazy-imports cluster.partmap inside methods)
 
 RETRYABLE_ERRORS: tuple[type[BaseException], ...] = (OSError, ServerBusyError)
+
+# For PARTITION-AWARE callers only: MovedError is retryable *after a map
+# refresh + re-route* — plain callers without a routing table would just
+# re-ask the same node and collect the same refusal, so it is deliberately
+# NOT in RETRYABLE_ERRORS.
+ROUTED_RETRYABLE_ERRORS: tuple[type[BaseException], ...] = (
+    RETRYABLE_ERRORS + (MovedError,)
+)

@@ -38,7 +38,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from merklekv_tpu.client import MerkleKVClient, MerkleKVError, ProtocolError
+from merklekv_tpu.client import (
+    MerkleKVClient,
+    MerkleKVError,
+    MovedError,
+    ProtocolError,
+)
 from merklekv_tpu.cluster.retry import SYNC_PEER, Deadline, RetryPolicy
 from merklekv_tpu.merkle.encoding import leaf_hash
 from merklekv_tpu.native_bindings import NativeEngine
@@ -236,9 +241,18 @@ class SyncManager:
         # walking a deeply stale tree would repair against ancient state).
         # 0 selects the default.
         tree_lag_limit: int = 0,
+        # Partitioned cluster mode: the partition this node owns. When
+        # set, every HASH/TREELEVEL the walk sends carries the pt=<pid>
+        # address, so a peer that no longer owns this partition (stale
+        # routing, mid-rebalance) answers ERROR MOVED instead of serving
+        # a DIFFERENT partition's tree — a walk comparing against the
+        # wrong partition would quietly mirror its whole keyspace as
+        # divergence. None = unpartitioned (no token).
+        partition_id: "Optional[int]" = None,
     ) -> None:
         self._engine = engine
         self._device = device
+        self._partition_id = partition_id
         self._mget_batch = mget_batch
         # Pairwise transfer strategy when roots differ: "auto" bisects the
         # tree (TREELEVEL walk) once the local keyspace reaches
@@ -307,15 +321,17 @@ class SyncManager:
         scope = tracewire.trace_scope(tracewire.new_context())
         return scope, scope.ctx
 
-    @staticmethod
-    def _attach_trace(client: MerkleKVClient) -> MerkleKVClient:
+    def _attach_trace(self, client: MerkleKVClient) -> MerkleKVClient:
         """Give the client the live token provider — every cluster verb it
         sends carries the active trace context — and turn on version
         stamps, so tree fetches report the engine version the donor's
         served tree reflects (both ride the same capability fallback
-        against old peers)."""
+        against old peers). On a partitioned node the client also carries
+        the pt=<pid> partition address (no fallback — see MerkleKVClient.
+        partition_id)."""
         client.trace_provider = tracewire.current_token
         client.version_stamps = True
+        client.partition_id = self._partition_id
         return client
 
     @staticmethod
@@ -333,6 +349,12 @@ class SyncManager:
             return
         try:
             client.tree_level(0, 0, 0)
+        except MovedError:
+            # The probe carries the pt= partition address: a MOVED answer
+            # means this peer serves a DIFFERENT partition, and every verb
+            # the caller would send next (LEAFHASHES/HASHPAGE) is
+            # unguarded — surface it, never settle-and-continue.
+            raise
         except Exception:
             pass  # capability state is settled either way
 
@@ -529,6 +551,15 @@ class SyncManager:
                         )
                         try:
                             roots_equal = client.hash() == local_hex
+                        except MovedError:
+                            # Partition mismatch is a ROUTING refusal, not
+                            # a degraded probe: the peer serves a DIFFERENT
+                            # partition, and falling through to a transfer
+                            # would mirror its disjoint keyspace as
+                            # divergence (mass quiet-deletes + foreign
+                            # imports). Abort the cycle loudly instead.
+                            get_metrics().inc("anti_entropy.moved_peers")
+                            raise
                         except Exception as e:
                             # A peer that serves data but not HASH still
                             # syncs — record the degradation, don't hide it.
@@ -793,6 +824,14 @@ class SyncManager:
         # tree trails its live engine.
         try:
             _, remote_n = client.tree_level(0, 0, 0)
+        except MovedError:
+            # Partition mismatch mid-cycle (ownership moved between the
+            # HASH probe and this one): NEVER degrade to the paged scan —
+            # HASHPAGE/LEAFHASHES carry no partition address, so the
+            # fallback would mirror the wrong partition's keyspace. Abort
+            # the cycle like the root probe does.
+            get_metrics().inc("anti_entropy.moved_peers")
+            raise
         except ProtocolError:
             return False, None  # no TREELEVEL on this peer
         except (MerkleKVError, OSError) as e:
@@ -807,6 +846,9 @@ class SyncManager:
             # pump synchronously) and walk the fresh tree.
             try:
                 _, remote_n = client.tree_level(0, 0, 0, force=True)
+            except MovedError:
+                get_metrics().inc("anti_entropy.moved_peers")
+                raise  # same rule as the plain probe above
             except ProtocolError:
                 return False, None
             except (MerkleKVError, OSError) as e:
@@ -1689,6 +1731,24 @@ class SyncManager:
                     )
                     report.degraded.append(peer)
                     continue
+            if self._partition_id is not None:
+                # Partition guard probe BEFORE the gather: the multi-peer
+                # path fetches via LEAFHASHES (no pt= address on the
+                # wire), so a stale-map peer serving a different
+                # partition would contribute its disjoint keyspace to the
+                # union and LWW would import it. One zero-width TREELEVEL
+                # (pt=-addressed) turns that into a loud per-peer skip.
+                try:
+                    c.tree_level(0, 0, 0)
+                except MovedError as e:
+                    get_metrics().inc("anti_entropy.moved_peers")
+                    drop_peer(
+                        c, peer, f"{peer}: wrong partition ({e})",
+                        outcome="error",
+                    )
+                    continue
+                except Exception:
+                    pass  # liveness/capability failures handled below
             self._settle_trace_capability(c)
             try:
                 decoded = _decode_leaf_map(c.leaf_hashes_ts())

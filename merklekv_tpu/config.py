@@ -396,12 +396,42 @@ class DeviceConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """[cluster]: partitioned cluster mode (cluster/partmap.py, router).
+
+    Off by default (``partitions = 0``) — a bare node serves the whole
+    keyspace exactly like the seed. When set, this node owns exactly ONE
+    partition of a ``partitions``-way hashed keyspace: the native dispatch
+    answers data verbs for foreign keys with the retryable ``ERROR MOVED
+    <pid> <epoch>``, the replication topic becomes partition-local
+    (``<topic_prefix>/p<pid>``), anti-entropy peers default to the
+    partition's sibling replicas from the map, and the node serves the
+    full map over the ``PARTMAP`` verb. See docs/DEPLOYMENT.md
+    "Partition sizing" and docs/PROTOCOL.md "Partitioned cluster mode".
+    """
+
+    # Total partitions in the cluster (0 = unpartitioned).
+    partitions: int = 0
+    # The ONE partition this node owns (required when partitions > 0).
+    partition_id: int = -1
+    # Full replica table, "0=host:port,host:port;1=host:port;...":
+    # every partition exactly once. Required when partitions > 0 — the
+    # node serves it via PARTMAP (smart clients/routers bootstrap from
+    # it) and derives sibling anti-entropy peers from its own group.
+    partition_map: str = ""
+    # Map generation: bump when installing a rebalanced map. Rides in
+    # every MOVED answer so stale clients know to refresh.
+    map_epoch: int = 1
+
+
+@dataclass
 class Config:
     host: str = "127.0.0.1"
     port: int = 7379
     storage_path: str = "merklekv_data"
     engine: str = "mem"
     sync_interval_seconds: float = 60.0
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
@@ -753,6 +783,47 @@ class Config:
             raise ValueError(
                 "[bootstrap] chunk_retries must be >= 1, got "
                 f"{cfg.bootstrap.chunk_retries}"
+            )
+        cl = raw.get("cluster", {})
+        if "partitions" in cl:
+            cfg.cluster.partitions = int(cl["partitions"])
+        if "partition_id" in cl:
+            cfg.cluster.partition_id = int(cl["partition_id"])
+        if "partition_map" in cl:
+            cfg.cluster.partition_map = str(cl["partition_map"])
+        if "map_epoch" in cl:
+            cfg.cluster.map_epoch = int(cl["map_epoch"])
+        if cfg.cluster.partitions < 0:
+            raise ValueError(
+                "[cluster] partitions must be >= 0 (0 = unpartitioned), "
+                f"got {cfg.cluster.partitions}"
+            )
+        if cfg.cluster.partitions > 0:
+            if not 0 <= cfg.cluster.partition_id < cfg.cluster.partitions:
+                raise ValueError(
+                    "[cluster] partition_id must be in "
+                    f"[0, {cfg.cluster.partitions}), got "
+                    f"{cfg.cluster.partition_id}"
+                )
+            if cfg.cluster.map_epoch < 1:
+                raise ValueError(
+                    "[cluster] map_epoch must be >= 1, got "
+                    f"{cfg.cluster.map_epoch}"
+                )
+            if not cfg.cluster.partition_map:
+                raise ValueError(
+                    "[cluster] partition_map is required when partitions "
+                    "> 0 (the node serves it via PARTMAP and derives its "
+                    "sibling peers from it)"
+                )
+            # Full validation (coverage, addresses) via the one parser
+            # every routing consumer shares.
+            from merklekv_tpu.cluster.partmap import parse_map_spec
+
+            parse_map_spec(
+                cfg.cluster.partition_map,
+                cfg.cluster.partitions,
+                cfg.cluster.map_epoch,
             )
         cfg.replication.resolve_env()
         return cfg

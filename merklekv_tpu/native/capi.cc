@@ -598,6 +598,19 @@ int mkv_server_degradation(void* h) {
   return static_cast<ServerHandle*>(h)->server->degradation();
 }
 
+// Partitioned cluster mode: this node owns partition `owned` of `count`
+// (map generation `epoch`). While count > 0, data verbs whose keys hash
+// to a foreign partition — and HASH/TREELEVEL requests addressed pt= to
+// one — answer the retryable "ERROR MOVED <pid> <epoch>". count 0 turns
+// the guard off (unpartitioned default).
+void mkv_server_set_partition(void* h, unsigned long long epoch,
+                              long long count, long long owned) {
+  if (count < 0) count = 0;
+  if (owned < 0) owned = 0;
+  static_cast<ServerHandle*>(h)->server->set_partition(
+      epoch, uint32_t(count), uint32_t(owned));
+}
+
 // Change-event queue depth (staged-but-undrained events) — the
 // replication/WAL feed's backlog gauge.
 long long mkv_server_events_depth(void* h) {

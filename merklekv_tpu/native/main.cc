@@ -19,6 +19,12 @@ int main(int argc, char** argv) {
   std::string engine_kind = "mem";
   std::string storage_path = "merklekv_data";
   long long io_threads = 0;  // 0 = hardware concurrency
+  // Partitioned cluster mode: "--partition PID/COUNT[/EPOCH]" makes this
+  // node own one partition of a COUNT-way keyspace — foreign keys answer
+  // "ERROR MOVED <pid> <epoch>" (the scale-out bench and ops smoke use
+  // this; the full map/PARTMAP plane lives in the Python control plane).
+  long long part_id = -1, part_count = 0;
+  unsigned long long part_epoch = 1;
 
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -39,10 +45,25 @@ int main(int argc, char** argv) {
       storage_path = next("--storage-path");
     } else if (a == "--io-threads") {
       io_threads = std::atoll(next("--io-threads"));
+    } else if (a == "--partition") {
+      const char* spec = next("--partition");
+      unsigned long long pid = 0, cnt = 0, ep = 1;
+      int got = std::sscanf(spec, "%llu/%llu/%llu", &pid, &cnt, &ep);
+      if (got < 2 || cnt == 0 || pid >= cnt) {
+        std::fprintf(stderr,
+                     "--partition wants PID/COUNT[/EPOCH] with PID < "
+                     "COUNT, got %s\n",
+                     spec);
+        return 2;
+      }
+      part_id = (long long)pid;
+      part_count = (long long)cnt;
+      part_epoch = ep;
     } else if (a == "--help" || a == "-h") {
       std::printf(
           "usage: merklekv-server [--host H] [--port P] "
-          "[--engine mem|log] [--storage-path DIR] [--io-threads N]\n");
+          "[--engine mem|log] [--storage-path DIR] [--io-threads N] "
+          "[--partition PID/COUNT[/EPOCH]]\n");
       return 0;
     } else {
       std::fprintf(stderr, "unknown flag: %s\n", a.c_str());
@@ -57,6 +78,10 @@ int main(int argc, char** argv) {
   opts.exit_on_shutdown = true;
   opts.io_threads = io_threads < 0 ? 0 : size_t(io_threads);
   mkv::Server server(engine.get(), opts);
+  if (part_count > 0) {
+    server.set_partition(part_epoch, uint32_t(part_count),
+                         uint32_t(part_id));
+  }
   if (!server.start()) {
     std::fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
     return 1;

@@ -180,6 +180,18 @@ void apply_version_flags(Command* c, int flags) {
   c->force_refresh = (flags & 2) != 0;
 }
 
+// If the last token is a partition-address token ("pt=" + decimal pid),
+// pop it and return the pid; -1 when absent. Clients append it BEFORE the
+// vs=/tc= tokens, so callers strip those first, then this.
+int64_t take_partition_token(std::vector<std::string>* toks) {
+  if (toks->empty() || !is_partition_token(toks->back())) return -1;
+  int64_t pid = 0;
+  const std::string& t = toks->back();
+  for (size_t i = 3; i < t.size(); ++i) pid = pid * 10 + (t[i] - '0');
+  toks->pop_back();
+  return pid;
+}
+
 }  // namespace
 
 bool is_trace_token(const std::string& tok) {
@@ -199,6 +211,17 @@ bool is_version_token(const std::string& tok) {
   // "vs=" + exactly 2 hex flag digits.
   return tok.size() == 5 && tok.compare(0, 3, "vs=") == 0 &&
          std::isxdigit(uint8_t(tok[3])) && std::isxdigit(uint8_t(tok[4]));
+}
+
+bool is_partition_token(const std::string& tok) {
+  // "pt=" + 1..10 decimal digits (enough for any 32-bit partition id).
+  if (tok.size() < 4 || tok.size() > 13 || tok.compare(0, 3, "pt=") != 0) {
+    return false;
+  }
+  for (size_t i = 3; i < tok.size(); ++i) {
+    if (tok[i] < '0' || tok[i] > '9') return false;
+  }
+  return true;
 }
 
 ParseResult parse_command(const std::string& line) {
@@ -231,6 +254,7 @@ ParseResult parse_command(const std::string& line) {
     if (u == "HASH") { c.verb = Verb::Hash; return ok(std::move(c)); }
     if (u == "LEAFHASHES") { c.verb = Verb::LeafHashes; return ok(std::move(c)); }
     if (u == "PEERS") { c.verb = Verb::Peers; return ok(std::move(c)); }
+    if (u == "PARTMAP") { c.verb = Verb::PartMap; return ok(std::move(c)); }
     if (u == "SNAPMETA") { c.verb = Verb::SnapMeta; return ok(std::move(c)); }
     if (u == "METRICS") { c.verb = Verb::Metrics; return ok(std::move(c)); }
     if (u == "TRACEDUMP") {
@@ -356,6 +380,7 @@ ParseResult parse_command(const std::string& line) {
     // anti-entropy compares); the pattern form keeps its legacy shape.
     auto toks = split_ws(rest);
     int vflags = take_version_flags(&toks);
+    int64_t pid = take_partition_token(&toks);
     if (toks.size() > 1) {
       return err("HASH command accepts only one argument");
     }
@@ -365,6 +390,7 @@ ParseResult parse_command(const std::string& line) {
     Command c;
     c.verb = Verb::Hash;
     c.pattern = toks.empty() ? "" : toks[0];
+    c.partition = pid;
     apply_version_flags(&c, vflags);
     return ok(std::move(c));
   }
@@ -475,6 +501,7 @@ ParseResult parse_command(const std::string& line) {
     auto toks = split_ws(rest);
     std::string trace = take_trace_token(&toks);
     int vflags = take_version_flags(&toks);
+    int64_t pid = take_partition_token(&toks);
     if (toks.size() != 3) {
       return err("TREELEVEL requires arguments: <level> <lo> <hi>");
     }
@@ -492,6 +519,7 @@ ParseResult parse_command(const std::string& line) {
     c.level = level;
     c.lo = lo;
     c.hi = hi;
+    c.partition = pid;
     apply_version_flags(&c, vflags);
     return ok(std::move(c));
   }

@@ -67,6 +67,12 @@ enum class Verb {
   // log. Stays open through LOADING and every degradation rung: forensics
   // must work exactly when the node is sick.
   Flight,
+  // Partitioned cluster mode: "PARTMAP" dumps the versioned partition map
+  // this node holds (epoch, partition count, replica set per partition) —
+  // the routing table smart clients and the thin router bootstrap from.
+  // Served by the cluster control plane; a node without one answers ERROR
+  // (the capability signal that the deployment is not partitioned).
+  PartMap,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };
@@ -102,6 +108,14 @@ struct Command {
   // (fail closed); clients drop it per connection and retry plain.
   bool want_version = false;
   bool force_refresh = false;
+  // Partition address: the optional trailing "pt=<pid>" token on the
+  // tree-serving verbs HASH and TREELEVEL (stripped before arity checks,
+  // after the vs=/tc= tokens). A partitioned node whose owned partition
+  // differs answers "ERROR MOVED <pid> <epoch>" instead of silently
+  // serving a DIFFERENT partition's tree into the caller's anti-entropy
+  // walk — the stale-map safety check for partition-scoped root reads.
+  // -1 = unaddressed (the legacy whole-node form).
+  int64_t partition = -1;
   std::string host;                // Sync
   uint16_t port = 0;               // Sync
   bool full = false, verify = false;  // Sync flags (parsed, ignored — parity)
@@ -130,5 +144,12 @@ bool is_trace_token(const std::string& tok);
 // (and the verbs where a collision would be silent require a settled
 // capability first — docs/PROTOCOL.md "Version-stamped tree answers").
 bool is_version_token(const std::string& tok);
+
+// True iff `tok` is a well-formed partition-address token: "pt=" + 1..10
+// decimal digits. Same trailing-token discipline; only parsed on verbs
+// with fixed arity (TREELEVEL) or a response shape that exposes the miss
+// (bare HASH echoes an unparsed token back as a pattern), so an old peer
+// can never silently misread it.
+bool is_partition_token(const std::string& tok);
 
 }  // namespace mkv

@@ -84,6 +84,7 @@ const char* verb_name(Verb v) {
     case Verb::TraceDump: return "TRACEDUMP";
     case Verb::Profile: return "PROFILE";
     case Verb::Flight: return "FLIGHT";
+    case Verb::PartMap: return "PARTMAP";
   }
   return "CMD";
 }
@@ -132,6 +133,64 @@ bool is_write_verb(Verb v) {
       return true;
     default:
       return false;
+  }
+}
+
+// key -> partition id: first 8 bytes of SHA-256(key) as a big-endian u64,
+// mod the partition count. MUST stay bit-identical to
+// cluster/partmap.py::partition_of — the smart clients, the router, and
+// this guard all route with the same function or MOVED ping-pongs forever.
+uint32_t partition_of_key(const std::string& key, uint32_t count) {
+  uint8_t d[32];
+  sha256(key.data(), key.size(), d);
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | d[i];
+  return uint32_t(v % count);
+}
+
+// First FOREIGN partition addressed by this command, or -1 when every key
+// (and any pt= tree address) belongs to `owned`. Only key-bearing data
+// verbs participate: keyless verbs (PING/STATS/SCAN/TRUNCATE/...) are
+// whole-node operations, and the management/anti-entropy plane must never
+// be refused by routing (it repairs what routing mistakes leave behind).
+int64_t foreign_partition(const Command& cmd, uint32_t count,
+                          uint32_t owned) {
+  switch (cmd.verb) {
+    case Verb::Get:
+    case Verb::Set:
+    case Verb::Delete:
+    case Verb::Increment:
+    case Verb::Decrement:
+    case Verb::Append:
+    case Verb::Prepend: {
+      uint32_t p = partition_of_key(cmd.key, count);
+      return p == owned ? -1 : int64_t(p);
+    }
+    case Verb::Exists:
+    case Verb::MultiGet:
+      for (const auto& k : cmd.keys) {
+        uint32_t p = partition_of_key(k, count);
+        if (p != owned) return int64_t(p);
+      }
+      return -1;
+    case Verb::MultiSet:
+      for (const auto& [k, v] : cmd.pairs) {
+        (void)v;
+        uint32_t p = partition_of_key(k, count);
+        if (p != owned) return int64_t(p);
+      }
+      return -1;
+    case Verb::Hash:
+    case Verb::TreeLevel:
+      // Partition-scoped tree addressing: a pt= token naming a partition
+      // this node does not own is a stale-map read — MOVED, never a
+      // silently different partition's tree into the caller's walk.
+      if (cmd.partition >= 0 && uint64_t(cmd.partition) != owned) {
+        return cmd.partition;
+      }
+      return -1;
+    default:
+      return -1;
   }
 }
 
@@ -916,6 +975,18 @@ std::string Server::stats_text() {
   add("pipeline_rejected", ld(stats_.pipeline_rejected));
   add("shed_commands", ld(stats_.shed_commands));
   add("readonly_commands", ld(stats_.readonly_commands));
+  // Partitioned cluster mode: the routing-guard refusal count plus the
+  // partition identity lines (emitted only while partitioned, so an
+  // unpartitioned node's STATS stays byte-compatible with older parsers).
+  add("moved_commands", ld(stats_.moved_commands));
+  {
+    const uint32_t pcount = part_count_.load(std::memory_order_acquire);
+    if (pcount > 0) {
+      add("partition_count", pcount);
+      add("partition_id", part_owned_.load(std::memory_order_acquire));
+      add("partition_epoch", part_epoch_.load(std::memory_order_acquire));
+    }
+  }
   // Zero-copy serving plane: the slab account (live/pinned bytes feed the
   // watermark story; pinned = bytes held only by in-flight responses)
   // plus the serve-path counters the bench A/B reads.
@@ -1039,6 +1110,25 @@ void Server::run_command(const std::string& line,
 }
 
 void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
+  // Partition guard FIRST (before the overload/serving gates): a key that
+  // does not belong here must be re-ROUTED, not retried here — BUSY or
+  // LOADING on a wrong-node request would send the client into a retry
+  // loop against a node that can never serve it. The MOVED answer carries
+  // the partition the key hashes to plus this node's map epoch, so a
+  // stale client refreshes its map and re-routes (typed MovedError in the
+  // clients; docs/PROTOCOL.md "Partitioned cluster mode").
+  const uint32_t pcount = part_count_.load(std::memory_order_acquire);
+  if (pcount > 0) {
+    const int64_t fp = foreign_partition(
+        cmd, pcount, part_owned_.load(std::memory_order_acquire));
+    if (fp >= 0) {
+      stats_.moved_commands.fetch_add(1, std::memory_order_relaxed);
+      out.lit("ERROR MOVED " + std::to_string(fp) + " " +
+              std::to_string(part_epoch_.load(std::memory_order_acquire)) +
+              "\r\n");
+      return;
+    }
+  }
   // Degradation ladder: shedding answers writes with a RETRYABLE BUSY
   // (memory/disk pressure is transient — clients back off and retry);
   // read_only/draining answer READONLY (not retryable until the node
@@ -1200,6 +1290,26 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
       }
       body += "END\r\n";
       out.payload(std::move(body));
+      return;
+    }
+    case Verb::PartMap: {
+      // Versioned partition map (extension verb): the routing table smart
+      // clients and the thin router bootstrap from. Only the control
+      // plane holds a map; a bare (or unpartitioned) node answers ERROR —
+      // the capability signal that this deployment has no partitions.
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp = cb("PARTMAP");
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
+      }
+      out.lit("ERROR partition map unavailable\r\n");
       return;
     }
     case Verb::Peers: {

@@ -369,6 +369,24 @@ class Server {
   int degradation() const {
     return degradation_.load(std::memory_order_acquire);
   }
+  // Partitioned cluster mode: this node owns exactly ONE partition of a
+  // P-way keyspace (partition = first 8 bytes of SHA-256(key), big-endian,
+  // mod P — identical to cluster/partmap.py). While count > 0, every
+  // key-bearing data verb whose key hashes to a FOREIGN partition answers
+  // the retryable "ERROR MOVED <pid> <epoch>" instead of serving — a
+  // client or router holding a stale partition map can never silently
+  // read/write the wrong node. HASH/TREELEVEL requests carrying a pt=
+  // address for a foreign partition answer MOVED the same way. The epoch
+  // rides in the answer so the client knows which map generation refused
+  // it. count 0 = unpartitioned (the guard is off, default).
+  void set_partition(uint64_t epoch, uint32_t count, uint32_t owned) {
+    part_epoch_.store(epoch, std::memory_order_release);
+    part_owned_.store(owned, std::memory_order_release);
+    part_count_.store(count, std::memory_order_release);
+  }
+  uint32_t partition_count() const {
+    return part_count_.load(std::memory_order_acquire);
+  }
   // Slow-command threshold in MICROSECONDS (0 = off, the default): a
   // dispatch taking at least this long is recorded in the flight log and
   // relayed to the control plane as a SLOWCMD notification. The load is
@@ -427,6 +445,10 @@ class Server {
   std::atomic<size_t> max_pipeline_{0};
   std::atomic<int> degradation_{0};     // Degradation enum value
   std::atomic<int> degrade_reason_{0};  // DegradeReason enum value
+  // Partitioned cluster mode (0 partitions = off; see set_partition).
+  std::atomic<uint64_t> part_epoch_{0};
+  std::atomic<uint32_t> part_count_{0};
+  std::atomic<uint32_t> part_owned_{0};
   std::atomic<bool> zero_copy_{true};   // GET/MGET block path vs compat copy
   bool reuseport_live_ = false;         // accept sharding resolved at start
   std::atomic<uint64_t> slow_threshold_us_{0};  // 0 = slow log off

@@ -84,6 +84,14 @@ struct ServerStats {
   std::atomic<uint64_t> pipeline_rejected{0};
   std::atomic<uint64_t> shed_commands{0};
   std::atomic<uint64_t> readonly_commands{0};
+  //   moved_commands            — key-bearing commands refused with
+  //                               "ERROR MOVED <pid> <epoch>" because the
+  //                               key (or addressed tree) belongs to a
+  //                               partition this node does not own — the
+  //                               stale-routing signal of partitioned
+  //                               cluster mode (never a silent wrong-node
+  //                               read/write).
+  std::atomic<uint64_t> moved_commands{0};
 
   // Zero-copy serving plane (extension lines):
   //   serve_zero_copy     — values (> OutQueue::kInlinePayload) served as
@@ -145,6 +153,7 @@ struct ServerStats {
       case Verb::TraceDump: management_commands++; break;
       case Verb::Profile: management_commands++; break;
       case Verb::Flight: management_commands++; break;
+      case Verb::PartMap: management_commands++; break;
       case Verb::Sync:
       case Verb::SnapMeta:
       case Verb::SnapChunk: sync_commands++; break;

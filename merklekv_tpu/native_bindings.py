@@ -205,6 +205,10 @@ def _load() -> ctypes.CDLL:
     lib.mkv_server_set_slow_threshold.argtypes = [
         ctypes.c_void_p, ctypes.c_longlong,
     ]
+    lib.mkv_server_set_partition.argtypes = [
+        ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_longlong,
+        ctypes.c_longlong,
+    ]
     lib.mkv_install_crash_marker.argtypes = [ctypes.c_char_p]
     lib.mkv_server_drain_events.argtypes = [
         ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
@@ -737,6 +741,17 @@ class NativeServer:
         if not self._h:
             return 0
         return int(self._lib.mkv_server_events_depth(self._h))
+
+    def set_partition(self, epoch: int, count: int, owned: int) -> None:
+        """Partitioned cluster mode: this node owns partition ``owned`` of
+        a ``count``-way keyspace at map generation ``epoch``. While
+        ``count`` > 0 the native dispatch refuses data verbs whose keys
+        hash to a FOREIGN partition (and HASH/TREELEVEL requests pt=-
+        addressed to one) with the retryable ``ERROR MOVED <pid>
+        <epoch>`` — a stale map can never silently read or write the
+        wrong node. ``count`` 0 disables the guard (the default)."""
+        if self._h:
+            self._lib.mkv_server_set_partition(self._h, epoch, count, owned)
 
     def set_slow_threshold(self, us: int) -> None:
         """Slow-command log threshold in microseconds (0 = off): a

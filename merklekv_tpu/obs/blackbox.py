@@ -306,6 +306,17 @@ def find_anomalies(
             add(e, "device_corruption",
                 f"scrub mismatch at leaf {f.get('leaf_index')} "
                 f"(rung {f.get('rung')})")
+        elif ev.kind == "partition_degraded":
+            # One partition's replica left live (partitioned cluster
+            # mode): the partition-scope summary below folds these across
+            # nodes to tell a partition-local incident (one replica
+            # group) from a cluster-wide one (every partition at once).
+            add(e, "partition_degraded",
+                f"partition {f.get('partition')} -> {f.get('level')} "
+                f"({f.get('reason')})")
+        elif ev.kind == "partition_healed":
+            add(e, "partition_healed",
+                f"partition {f.get('partition')} back to live")
         elif ev.kind in ("admission_reject", "pipeline_reject",
                          "events_dropped"):
             add(e, "rejection_burst", f"{ev.kind} +{f.get('count')}")
@@ -371,6 +382,62 @@ def find_anomalies(
     return out
 
 
+# Anomaly kinds that count toward partition-incident scoping: the ones a
+# sick replica produces about ITSELF (a peer_flip is the observer's view
+# of someone else's failure and would smear the blame across partitions).
+_PARTITION_SCOPED_KINDS = (
+    "degradation",
+    "partition_degraded",
+    "storage_full",
+    "fatal_signal",
+    "rejection_burst",
+)
+
+
+def partition_incident_scope(report: Report) -> Optional[str]:
+    """One-line verdict: is this incident partition-local or cluster-wide?
+
+    Nodes advertise their partition on node_start/map_change flight
+    events; anomalies a replica raised about itself fold by that
+    partition. One affected partition = a partition-local incident (the
+    containment story working); most/all partitions at once = a
+    cluster-wide cause (deploy, fabric, shared disk). None when no spill
+    names a partition (unpartitioned deployment)."""
+    node_part: dict[str, int] = {}
+    for doc in report.docs:
+        for ev in doc.events:
+            if ev.kind in ("node_start", "map_change"):
+                p = ev.fields.get("partition")
+                if p is not None and str(p).lstrip("-").isdigit():
+                    node_part[doc.node] = int(p)
+    if not node_part:
+        return None
+    known = sorted(set(node_part.values()))
+    hit = sorted(
+        {
+            node_part[a.node]
+            for a in report.anomalies
+            if a.kind in _PARTITION_SCOPED_KINDS and a.node in node_part
+        }
+    )
+    if not hit:
+        return (
+            f"partitions {known}: no replica-local anomalies "
+            "(healthy or observer-only flips)"
+        )
+    if len(hit) == 1:
+        return (
+            f"PARTITION-LOCAL incident: partition {hit[0]} only "
+            f"(of {known}) — containment held"
+        )
+    if len(hit) >= max(2, len(known)):
+        return (
+            f"CLUSTER-WIDE incident: every observed partition affected "
+            f"({hit}) — look for a shared cause"
+        )
+    return f"multi-partition incident: partitions {hit} of {known}"
+
+
 def _fmt_wall(wall_ns: int) -> str:
     if wall_ns <= 0:
         return "????-??-?? ??:??:??.???"
@@ -421,6 +488,11 @@ def render_text(report: Report, limit: int = 0) -> str:
         )
     if not report.anomalies:
         lines.append("(none)")
+    scope = partition_incident_scope(report)
+    if scope is not None:
+        lines.append("")
+        lines.append("== partition scope ==")
+        lines.append(scope)
     return "\n".join(lines)
 
 
@@ -450,6 +522,7 @@ def render_json(report: Report) -> str:
                 for e in report.timeline
             ],
             "trace_links": report.trace_links,
+            "partition_scope": partition_incident_scope(report),
             "anomalies": [
                 {
                     "wall_ns": a.wall_ns,

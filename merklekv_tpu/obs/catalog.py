@@ -33,6 +33,10 @@ CATALOG: dict[str, tuple[str, str]] = {
         "counter", "Keys set or deleted by anti-entropy repair."),
     "anti_entropy.peer_degraded": (
         "counter", "Sync streams that died mid-cycle (peer degraded)."),
+    "anti_entropy.moved_peers": (
+        "counter", "Sync cycles aborted because the peer answered MOVED "
+        "(it serves a different partition — stale routing; the walk "
+        "never mirrors a disjoint keyspace)."),
     "anti_entropy.sessions_checkpointed": (
         "counter", "Interrupted repairs checkpointed for resume."),
     "anti_entropy.sessions_resumed": (
@@ -234,6 +238,22 @@ CATALOG: dict[str, tuple[str, str]] = {
         "counter", "SNAPCHUNK frames served as a donor."),
     "bootstrap.donor_bytes": (
         "counter", "Raw snapshot bytes served as a donor."),
+    # -- partitioned cluster mode ------------------------------------------
+    "partition.degraded_total": (
+        "counter", "Times this replica's partition left live (ladder rose "
+        "above live while partitioned)."),
+    "partition.healed_total": (
+        "counter", "Times this replica's partition returned to live."),
+    "router.commands": (
+        "counter", "Commands dispatched by the thin partition router."),
+    "router.map_refreshes": (
+        "counter", "Partition-map refreshes performed by the router."),
+    "router.moved_refreshes": (
+        "counter", "Router commands that hit ERROR MOVED (stale map) and "
+        "re-routed after a refresh."),
+    "router.backend_errors": (
+        "counter", "Router commands failed by an unreachable/failing "
+        "backend replica."),
     # -- overload protection ------------------------------------------------
     "node.degradation_changes": (
         "counter", "Degradation-ladder transitions (live/shedding/"
@@ -258,6 +278,18 @@ CATALOG: dict[str, tuple[str, str]] = {
     "native.busy_rejected_connections": (
         "counter", "Accepts refused past [server] max_connections "
         "(answered ERROR BUSY and closed)."),
+    "native.moved_commands": (
+        "counter", "Key-bearing commands refused with ERROR MOVED because "
+        "the key (or pt=-addressed tree) belongs to a partition this node "
+        "does not own — stale client/router routing."),
+    "native.partition_count": (
+        "gauge", "Partitions in the cluster keyspace (absent/0 = "
+        "unpartitioned node)."),
+    "native.partition_id": (
+        "gauge", "The ONE partition this node owns (partitioned mode)."),
+    "native.partition_epoch": (
+        "gauge", "Partition-map generation this node enforces; rides in "
+        "every MOVED answer."),
     "native.pipeline_rejected": (
         "counter", "Connections closed for exceeding their in-flight "
         "pipeline budget."),

@@ -80,6 +80,10 @@ class NodeSample:
     # 1 single-device, 0 CPU golden, -1 native fallback; -2 = the line is
     # absent (node predates the ladder / no mirror), rendered "-".
     backend_level: int = -2
+    # Partition plane (METRICS partition.id line): the partition this
+    # replica serves in a partitioned cluster — rendered as the PART
+    # column ("-" on unpartitioned nodes).
+    partition: int = -1
     # io plane (STATS io_threads / io_worker_<i>_commands lines): pool
     # width and per-worker cumulative command counts — rendered as the W
     # and OPS/S/W (busiest worker's rate) columns ("-" on nodes predating
@@ -190,6 +194,7 @@ def sample_node(
         ("tree_version", "device.tree_version"),
         ("engine_version", "node.engine_version"),
         ("backend_level", "device.backend_level"),
+        ("partition", "partition.id"),
     ):
         try:
             setattr(s, attr, int(metrics[key]))
@@ -245,7 +250,8 @@ def render_table(
     prev: dict[str, NodeSample], cur: dict[str, NodeSample]
 ) -> str:
     header = (
-        f"{'NODE':<22} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} {'GET/S':>8} "
+        f"{'NODE':<22} {'PART':>4} {'KEYS':>9} {'OPS/S':>8} {'SET/S':>8} "
+        f"{'GET/S':>8} "
         f"{'P50_US':>7} {'SRV_MB/S':>9} {'SYNC_KB/S':>10} {'CONNS':>5} "
         f"{'W':>3} "
         f"{'OPS/S/W':>8} {'PEERS_UP':>9} "
@@ -258,7 +264,8 @@ def render_table(
         c = cur[node]
         p = prev.get(node)
         if not c.ok:
-            lines.append(f"{node:<22} {'-':>9} {'-':>8} {'-':>8} {'-':>8} "
+            lines.append(f"{node:<22} {'-':>4} {'-':>9} {'-':>8} {'-':>8} "
+                         f"{'-':>8} "
                          f"{'-':>7} {'-':>9} {'-':>10} {'-':>5} {'-':>3} "
                          f"{'-':>8} "
                          f"{'-':>9} "
@@ -307,8 +314,11 @@ def render_table(
         # fallback=-1); "-" on nodes predating the ladder or without a
         # mirror.
         bknd = f"{c.backend_level}" if c.backend_level >= -1 else "-"
+        # PART = the partition this replica serves ("-" unpartitioned).
+        part = f"{c.partition}" if c.partition >= 0 else "-"
         lines.append(
-            f"{node:<22} {c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
+            f"{node:<22} {part:>4} "
+            f"{c.keys:>9} {ops:>8.1f} {sets:>8.1f} {gets:>8.1f} "
             f"{p50:>7} {srv_mb:>9.1f} {sync_kb:>10.1f} "
             f"{c.active_connections:>5} "
             f"{w:>3} {per_worker:>8.1f} "

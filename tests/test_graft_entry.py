@@ -135,3 +135,53 @@ def test_watchdog_exits_with_sidecar_and_record(tmp_path):
     # The stuck phase's elapsed time was closed out by the final rewrite.
     stuck = [p for p in doc["phases"] if p["phase"] == "mesh-init-sim"]
     assert stuck and "seconds" in stuck[0]
+
+
+def test_watchdog_record_names_the_partition(tmp_path):
+    """Partitioned runs (MKV_PARTITION_ID set) stamp the active partition
+    on every phase breadcrumb, the watchdog's JSON record, and the
+    MULTICHIP_FLIGHT.bin dump — a stuck phase then names WHICH
+    partition's mesh wedged, not just which phase (the r05-class blind
+    timeout, scoped)."""
+    import json
+    import subprocess
+
+    phase_file = tmp_path / "phases.json"
+    flight_file = tmp_path / "MULTICHIP_FLIGHT.bin"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = "\n".join(
+        [
+            "import os, sys, time",
+            "os.environ['MKV_MULTICHIP_DEADLINE_S'] = '1'",
+            "os.environ['MKV_PARTITION_ID'] = '3'",
+            f"os.environ['MKV_PHASE_FILE'] = {str(phase_file)!r}",
+            f"os.environ['MKV_FLIGHT_FILE'] = {str(flight_file)!r}",
+            f"sys.path.insert(0, {root!r})",
+            "import __graft_entry__ as g",
+            "g._start_watchdog()",
+            "g._phase('mesh-init-sim')",
+            "time.sleep(60)  # simulated hang",
+        ]
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    assert out.returncode == 3, (out.returncode, out.stderr[-1000:])
+    # The stderr breadcrumb names the partition inline.
+    assert "# MULTICHIP PHASE mesh-init-sim partition=3" in out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["partition"] == 3
+    assert all(p.get("partition") == 3 for p in rec["phases"])
+    # The flight dump attributes its events to the partitioned probe.
+    from merklekv_tpu.obs.flightrec import read_spill
+
+    doc = read_spill(str(flight_file))
+    assert doc.node == "multichip-probe-p3"
+    kinds = [e.kind for e in doc.events]
+    assert "multichip_phase" in kinds
+    assert any(
+        e.fields.get("partition") in (3, "3") for e in doc.events
+    )
