@@ -44,6 +44,13 @@ def main(argv: list[str] | None = None) -> int:
         from merklekv_tpu.cluster.router import main as router_main
 
         return router_main(argv[1:])
+    if argv and argv[0] == "rebalance":
+        # Live partition rebalancing: drive an online split (epoch E+1)
+        # against the serving cluster with zero-loss handoff
+        # (docs/DEPLOYMENT.md "Online rebalancing").
+        from merklekv_tpu.cluster.rebalance import main as rebalance_main
+
+        return rebalance_main(argv[1:])
     if argv and argv[0] == "trace":
         # Cross-node causal-trace assembly: TRACEDUMP from every node,
         # stitched into one Perfetto-loadable Chrome trace

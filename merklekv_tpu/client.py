@@ -170,15 +170,18 @@ def _decode_chunk(
 
 
 def _parse_partmap_header(header: str) -> int:
-    """Row count from a ``PARTMAP <epoch> <count>`` header (shared
-    sync/async). Validated BEFORE any body read so a garbled header can
-    never leave the client waiting out rows that will not come; the full
-    semantic validation happens in ``PartitionMap.from_wire``."""
+    """Row count from a ``PARTMAP <epoch> <count>`` header — or the split
+    form ``PARTMAP <epoch> <count> <base>`` a mid-rebalance cluster serves
+    (shared sync/async). Validated BEFORE any body read so a garbled
+    header can never leave the client waiting out rows that will not come;
+    the full semantic validation happens in ``PartitionMap.from_wire``."""
     fields = header.split(" ")
-    if len(fields) != 3 or fields[0] != "PARTMAP":
+    if len(fields) not in (3, 4) or fields[0] != "PARTMAP":
         raise ProtocolError(f"unexpected response: {header}")
     try:
         count = int(fields[2])
+        if len(fields) == 4:
+            int(fields[3])  # split-map hash base; semantics in from_wire
     except ValueError as e:
         raise ProtocolError(f"malformed PARTMAP header: {header!r}") from e
     if not 0 < count <= 65536:
@@ -950,6 +953,14 @@ class MerkleKVClient:
     def replicate(self, action: str) -> str:
         return _parse_simple(self._request(f"REPLICATE {action}"))
 
+    def rebalance(self, subcommand: str) -> str:
+        """One REBALANCE control exchange (``SPLIT``/``JOIN``/``STATUS``/
+        ``FENCE``/``COMMIT``/``ABORT`` + arguments); returns the single
+        response line. ERROR answers raise ProtocolError like every other
+        simple-response verb — the rebalance driver's retry loops key off
+        that."""
+        return _parse_simple(self._request(f"REBALANCE {subcommand}"))
+
     # -- pipeline ------------------------------------------------------------
     def pipeline(self, commands: Iterable[str]) -> list[str]:
         """Send raw command lines back-to-back, collect one response line per
@@ -1447,6 +1458,7 @@ class PartitionedClient:
         timeout: float = 5.0,
         max_value_bytes: int = 1 << 20,
         moved_retries: int = 4,
+        busy_retries: int = 8,
     ) -> None:
         if not seeds:
             raise ValueError("PartitionedClient needs at least one seed")
@@ -1454,6 +1466,13 @@ class PartitionedClient:
         self.timeout = timeout
         self.max_value_bytes = max_value_bytes
         self.moved_retries = moved_retries
+        # BUSY rides its own budget, separate from MOVED: a live
+        # rebalance fences the moving range for the flip window (writes
+        # answer the retryable BUSY), then either clears it (rollback) or
+        # flips the epoch (the next attempt heals through MOVED). Budgets
+        # must not share, or a long fence would starve the MOVED healing
+        # that follows it.
+        self.busy_retries = busy_retries
         self._map = None  # PartitionMap
         self._conns: dict[int, MerkleKVClient] = {}
         self._replica_idx: dict[int, int] = {}
@@ -1581,22 +1600,41 @@ class PartitionedClient:
         if self._map is None:
             self.refresh_map()
         last: Optional[Exception] = None
+        busy_left = max(0, self.busy_retries)
+        busy_delay = 0.05
         for attempt in range(max(1, self.moved_retries)):
             if attempt:
                 time.sleep(min(0.05 * (2 ** (attempt - 1)), 0.5))
-            pid = pid_of()
-            try:
-                return fn(self._client(pid), pid)
-            except MovedError as e:
-                last = e
-                self._drop(pid)
+            while True:
+                pid = pid_of()
                 try:
-                    self.refresh_map(min_epoch=e.epoch)
-                except ConnectionError as re:
-                    last = re
-            except ConnectionError as e:
-                last = e
-                self._drop(pid, rotate=True)
+                    return fn(self._client(pid), pid)
+                except ServerBusyError as e:
+                    # Rebalance fence window: wait it out on its own
+                    # budget, then re-route — the map may have flipped
+                    # under the fence.
+                    last = e
+                    if busy_left <= 0:
+                        raise
+                    busy_left -= 1
+                    time.sleep(busy_delay)
+                    busy_delay = min(busy_delay * 2, 0.5)
+                    try:
+                        self.refresh_map()
+                    except ConnectionError:
+                        pass
+                    continue
+                except MovedError as e:
+                    last = e
+                    self._drop(pid)
+                    try:
+                        self.refresh_map(min_epoch=e.epoch)
+                    except ConnectionError as re:
+                        last = re
+                except ConnectionError as e:
+                    last = e
+                    self._drop(pid, rotate=True)
+                break
         raise last  # type: ignore[misc]
 
     def _run(self, key: str, fn):
@@ -1614,27 +1652,47 @@ class PartitionedClient:
         if self._map is None:
             self.refresh_map()
         last: Optional[Exception] = None
+        busy_left = max(0, self.busy_retries)
+        busy_delay = 0.05
         for attempt in range(max(1, self.moved_retries)):
             if attempt:
                 time.sleep(min(0.05 * (2 ** (attempt - 1)), 0.5))
-            groups: dict[int, list[str]] = {}
-            for k in keys:
-                groups.setdefault(self._map.partition_for_key(k), []).append(k)
-            out = []
-            try:
-                for pid, sub in sorted(groups.items()):
-                    out.append((sub, fn(self._client(pid), sub)))
-                return out
-            except MovedError as e:
-                last = e
-                self.close()
+            while True:
+                groups: dict[int, list[str]] = {}
+                for k in keys:
+                    groups.setdefault(
+                        self._map.partition_for_key(k), []
+                    ).append(k)
+                out = []
                 try:
-                    self.refresh_map(min_epoch=e.epoch)
-                except ConnectionError as re:
-                    last = re
-            except ConnectionError as e:
-                last = e
-                self.close()
+                    for pid, sub in sorted(groups.items()):
+                        out.append((sub, fn(self._client(pid), sub)))
+                    return out
+                except ServerBusyError as e:
+                    # Rebalance fence window (same shape as _routed):
+                    # separate budget, regrouped under a refreshed map.
+                    last = e
+                    if busy_left <= 0:
+                        raise
+                    busy_left -= 1
+                    time.sleep(busy_delay)
+                    busy_delay = min(busy_delay * 2, 0.5)
+                    try:
+                        self.refresh_map()
+                    except ConnectionError:
+                        pass
+                    continue
+                except MovedError as e:
+                    last = e
+                    self.close()
+                    try:
+                        self.refresh_map(min_epoch=e.epoch)
+                    except ConnectionError as re:
+                        last = re
+                except ConnectionError as e:
+                    last = e
+                    self.close()
+                break
         raise last  # type: ignore[misc]
 
     # -- data plane --------------------------------------------------------
@@ -1717,6 +1775,7 @@ class AsyncPartitionedClient:
         timeout: float = 5.0,
         max_value_bytes: int = 1 << 20,
         moved_retries: int = 4,
+        busy_retries: int = 8,
     ) -> None:
         if not seeds:
             raise ValueError("AsyncPartitionedClient needs at least one seed")
@@ -1724,6 +1783,7 @@ class AsyncPartitionedClient:
         self.timeout = timeout
         self.max_value_bytes = max_value_bytes
         self.moved_retries = moved_retries
+        self.busy_retries = busy_retries
         self._map = None
         self._conns: dict[int, AsyncMerkleKVClient] = {}
         self._replica_idx: dict[int, int] = {}
@@ -1830,22 +1890,40 @@ class AsyncPartitionedClient:
         if self._map is None:
             await self.refresh_map()
         last: Optional[Exception] = None
+        busy_left = max(0, self.busy_retries)
+        busy_delay = 0.05
         for attempt in range(max(1, self.moved_retries)):
             if attempt:
                 await asyncio.sleep(min(0.05 * (2 ** (attempt - 1)), 0.5))
-            pid = pid_of()
-            try:
-                return await fn(await self._client(pid), pid)
-            except MovedError as e:
-                last = e
-                await self._drop(pid)
+            while True:
+                pid = pid_of()
                 try:
-                    await self.refresh_map(min_epoch=e.epoch)
-                except ConnectionError as re:
-                    last = re
-            except ConnectionError as e:
-                last = e
-                await self._drop(pid, rotate=True)
+                    return await fn(await self._client(pid), pid)
+                except ServerBusyError as e:
+                    # Rebalance fence window (same shape as the sync
+                    # client): own budget, re-routed after the wait.
+                    last = e
+                    if busy_left <= 0:
+                        raise
+                    busy_left -= 1
+                    await asyncio.sleep(busy_delay)
+                    busy_delay = min(busy_delay * 2, 0.5)
+                    try:
+                        await self.refresh_map()
+                    except ConnectionError:
+                        pass
+                    continue
+                except MovedError as e:
+                    last = e
+                    await self._drop(pid)
+                    try:
+                        await self.refresh_map(min_epoch=e.epoch)
+                    except ConnectionError as re:
+                        last = re
+                except ConnectionError as e:
+                    last = e
+                    await self._drop(pid, rotate=True)
+                break
         raise last  # type: ignore[misc]
 
     async def _run(self, key: str, fn):

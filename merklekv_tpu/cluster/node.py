@@ -86,6 +86,10 @@ class ClusterNode:
         # IS the per-partition Merkle root).
         self._partmap = None
         self._partition_id: Optional[int] = None
+        # Live-rebalance plane: the per-node session state machine (donor /
+        # joiner / sibling roles), built lazily on the first REBALANCE verb.
+        self._rebalance = None
+        self._rebalance_mu = threading.Lock()
         if cfg.cluster.partitions > 0:
             from merklekv_tpu.cluster.partmap import parse_map_spec
 
@@ -107,6 +111,24 @@ class ClusterNode:
                 cfg.cluster.map_epoch,
             )
             self._partition_id = cfg.cluster.partition_id
+        if storage is not None:
+            # Durable map-file overlay: a node that committed a split
+            # persists epoch E+1 (and its possibly-new partition id) under
+            # its storage directory at the rebalance commit point. Boot
+            # config is typically still at E, so the file — strictly newer
+            # — wins; this is what makes the epoch flip survive kill -9.
+            # It also resurrects a committed JOINER (whose boot config has
+            # partitions == 0) straight into its adopted partition.
+            from merklekv_tpu.cluster.partmap import load_map_file
+
+            loaded = load_map_file(storage.directory)
+            if loaded is not None:
+                pmap, pid = loaded
+                if self._partmap is None or pmap.epoch > self._partmap.epoch:
+                    self._partmap = pmap
+                    self._partition_id = pid
+                    cfg.anti_entropy.peers = []  # re-derive from the map
+        if self._partmap is not None:
             if not cfg.anti_entropy.peers and cfg.port:
                 # Sibling derivation: the partition's other replicas are
                 # exactly the peers anti-entropy (and bootstrap donors)
@@ -154,11 +176,14 @@ class ClusterNode:
         # command, a foreign key answers ERROR MOVED instead of landing in
         # (and polluting) this partition's keyspace.
         if self._partmap is not None:
-            self._server.set_partition(
-                self._partmap.epoch,
-                self._partmap.count,
-                self._partition_id,
-            )
+            self._install_partition_guard()
+            # Boot foreign-key sweep: a donor (or sibling) killed after
+            # the epoch persisted but before its moved-range drop ran
+            # restarts owning the NARROWED cell while the engine still
+            # holds the moved keys. Quiet-drop them behind the guard —
+            # the joiner owns them now, and serving them here would be
+            # double-ownership.
+            self._boot_foreign_sweep()
         # Overload protection BEFORE anything serves: admission limits go
         # to the native accept path, and the watermark monitor starts
         # pushing the degradation ladder (its first poll runs inline, so
@@ -358,6 +383,10 @@ class ClusterNode:
         if self._health is not None:
             self._health.stop()
             self._health = None
+        with self._rebalance_mu:
+            rebalance = self._rebalance
+        if rebalance is not None:
+            rebalance.stop()
         self._disable_replication()
         if self._owns_transport and self._transport is not None:
             self._transport.close()
@@ -395,6 +424,87 @@ class ClusterNode:
     @property
     def replicator(self) -> Optional[Replicator]:
         return self._replicator
+
+    # -- live rebalancing -----------------------------------------------------
+    def _rebalance_manager(self):
+        with self._rebalance_mu:
+            if self._rebalance is None:
+                from merklekv_tpu.cluster.rebalance import RebalanceManager
+
+                self._rebalance = RebalanceManager(self)
+            return self._rebalance
+
+    def _rebalance_state_code(self) -> int:
+        with self._rebalance_mu:
+            rebalance = self._rebalance
+        return rebalance.state_code() if rebalance is not None else 0
+
+    def _install_partition_guard(self) -> None:
+        """Push the current map into the native guard. Unsplit maps take
+        the legacy modulo path (byte-identical to pre-rebalance behavior);
+        split maps install the full cell table so foreign keys answer
+        ``ERROR MOVED <owner> <epoch>`` with split-tree routing."""
+        pmap, pid = self._partmap, self._partition_id
+        if pmap is None or pid is None:
+            return
+        if pmap.is_split:
+            self._server.set_partition_map(
+                pmap.epoch,
+                pmap.hash_base,
+                pid,
+                [pmap.assignment(p) for p in range(pmap.count)],
+            )
+        else:
+            self._server.set_partition(pmap.epoch, pmap.count, pid)
+
+    def adopt_partition_map(self, pmap, pid: Optional[int] = None) -> None:
+        """Commit a new partition-map epoch on this node: persist it
+        (THE durability point — a kill one instruction later restarts at
+        the new epoch), then swap the in-memory map and the native guard.
+        ``pid`` defaults to the current identity (donor/sibling); the
+        joiner passes its newly-owned partition."""
+        from merklekv_tpu.cluster.partmap import save_map_file
+
+        if pid is None:
+            pid = self._partition_id
+        if self._storage is not None:
+            save_map_file(self._storage.directory, pmap, pid)
+        self._partmap = pmap
+        self._partition_id = pid
+        self._install_partition_guard()
+        from merklekv_tpu.obs.flightrec import record
+
+        record(
+            "map_change",
+            epoch=pmap.epoch,
+            partitions=pmap.count,
+            partition=pid,
+        )
+
+    def _boot_foreign_sweep(self) -> None:
+        """Quiet-drop every key outside this node's owned cell. Only a
+        split map can leave residue (a crash between the epoch persist and
+        the moved-range drop); boot-shaped maps skip the scan entirely."""
+        pmap, pid = self._partmap, self._partition_id
+        if pmap is None or pid is None or not pmap.is_split:
+            return
+        from merklekv_tpu.cluster.partmap import key_in_range
+
+        base = pmap.hash_base
+        root, depth, path = pmap.assignment(pid)
+        dropped = 0
+        for k, _ in self._engine.snapshot():
+            if not key_in_range(k, base, root, depth, path):
+                if self._engine.delete_quiet(k):
+                    dropped += 1
+        if dropped:
+            if self._storage is not None:
+                self._storage.request_snapshot()
+            from merklekv_tpu.obs.flightrec import record
+            from merklekv_tpu.utils.tracing import get_metrics
+
+            get_metrics().inc("rebalance.boot_swept_keys", dropped)
+            record("rebalance_boot_sweep", keys=dropped, partition=pid)
 
     # -- replication management ---------------------------------------------
     def _get_transport(self) -> Transport:
@@ -830,6 +940,12 @@ class ClusterNode:
             payload["partition"] = self._partition_id
             payload["partition_epoch"] = self._partmap.epoch
             payload["partition_state"] = LEVEL_NAMES.get(level, "live")
+        with self._rebalance_mu:
+            rebalance = self._rebalance
+        if rebalance is not None and rebalance.state != "idle":
+            # Surfaced only while a session is (or recently was) live —
+            # the steady-state payload stays byte-compatible.
+            payload["rebalance"] = rebalance.state
         lag = self.lag_tracker.lag_events()
         if lag:
             payload["lag_events"] = sum(lag.values())
@@ -963,6 +1079,10 @@ class ClusterNode:
             ("node.degradation", self.ladder.level,
              "Overload degradation ladder (0=live 1=shedding 2=read_only "
              "3=draining).", ""),
+            ("rebalance.state", self._rebalance_state_code,
+             "Live-rebalance session phase (0=idle, donor 1-7 "
+             "conscribe..done, joiner 10-13, negative=failed/aborted).",
+             ""),
         ]
         if self._partition_id is not None:
             pid = str(self._partition_id)
@@ -1090,6 +1210,7 @@ class ClusterNode:
             lines.append(f"partition.epoch:{self._partmap.epoch}")
             lines.append(f"partition.count:{self._partmap.count}")
             lines.append(f"partition.state:{self.ladder.level()}")
+        lines.append(f"rebalance.state:{self._rebalance_state_code()}")
         # Overload plane: the ladder rung plus the native shed counters
         # (one stats_text read), so wire-only consumers (top's STATE and
         # SHED/s columns) see overload state without scraping /metrics.
@@ -1148,6 +1269,12 @@ class ClusterNode:
             if self._health is None:
                 return None  # native default: empty table
             return self._health.wire_table()
+        if parts[0] == "REBALANCE":
+            # Live-rebalance control plane. Relayed by the native server
+            # OUTSIDE the degradation/serving gates: a fenced sibling or a
+            # non-serving joiner must still take COMMIT/ABORT, or a
+            # wobbling node could wedge the whole session.
+            return self._rebalance_manager().handle(parts[1:])
         if parts[0] == "PARTMAP":
             # Versioned partition map: any member serves the full routing
             # table (smart clients/routers bootstrap from whichever node

@@ -182,6 +182,19 @@ class Replicator:
         # unverified state ever serves.
         self._holding = False
         self._held: list[tuple[list[ChangeEvent], dict]] = []
+        # Rebalance range-forward (double-apply): while armed, every event
+        # whose key satisfies the predicate is ALSO published on the
+        # forward topic — from both the local-drain side (flush) and the
+        # remote-apply side (_on_message), so a write landing on any
+        # replica of the donor group reaches the joiner no matter which
+        # node this replicator runs on. Duplicates are harmless: the
+        # joiner applies under the same LWW ts + op_id discipline as any
+        # inbound frame.
+        self._fwd_mu = threading.Lock()
+        self._fwd_topic: Optional[str] = None
+        self._fwd_pred: Optional[Callable[[bytes], bool]] = None
+        self._fwd_seq = 0
+        self.forwarded = 0
         # ONE pinned bound-method object for subscribe/unsubscribe:
         # transports remove subscriptions by callback IDENTITY, and
         # ``self._on_message`` evaluates to a FRESH bound method on every
@@ -251,12 +264,78 @@ class Replicator:
                 # the /metrics endpoint) can see replication flow without a
                 # handle on this object.
                 get_metrics().inc("replicator.published", published)
+            self._range_forward(publishable)
             if self._batch_listener is not None:
                 try:
                     self._batch_listener(events)
                 except Exception:
                     pass
             return len(events)
+
+    # -- rebalance range-forward --------------------------------------------
+    def set_range_forward(
+        self, topic: str, predicate: Callable[[bytes], bool]
+    ) -> None:
+        """Arm the double-apply: events whose encoded key satisfies
+        ``predicate`` are additionally published on ``topic`` (the joiner's
+        replication topic) until :meth:`clear_range_forward`."""
+        with self._fwd_mu:
+            self._fwd_topic = topic
+            self._fwd_pred = predicate
+
+    def clear_range_forward(self) -> None:
+        with self._fwd_mu:
+            self._fwd_topic = None
+            self._fwd_pred = None
+
+    def forward_events(self, topic: str, events: list[ChangeEvent]) -> int:
+        """Publish ``events`` as envelope frames on an arbitrary ``topic``
+        (rebalance transfer stream + commit-time sweep). The envelope src
+        is this node — the joiner's echo filter keys on ITS OWN id, so
+        forwarded frames always pass, while the per-event src fields keep
+        their original writers for skew attribution."""
+        published = 0
+        for frame in self._split_frames(events):
+            self._fwd_seq += len(frame)
+            payload = encode_batch_cbor(
+                frame,
+                self.node_id,
+                hwm_seq=self._fwd_seq,
+                hwm_ts=time.time_ns(),
+            )
+            try:
+                self._retry.run(
+                    lambda: self._transport.publish(topic, payload),
+                    retry_on=(Exception,),
+                    should_stop=self._stop.is_set,
+                )
+                published += len(frame)
+            except Exception:
+                # Same QoS-0 discipline as the main topic: drop and count.
+                # The rebalance flip only proceeds once donor and joiner
+                # range roots MATCH, so a dropped forward frame can delay
+                # the flip (re-verify retries) but never lose a key.
+                self.publish_errors += 1
+                get_metrics().inc("replicator.forward_errors")
+        if published:
+            self.forwarded += published
+            get_metrics().inc("replicator.forwarded", published)
+        return published
+
+    def _range_forward(self, events: list[ChangeEvent]) -> None:
+        """Forward the moving-range subset of one event batch, if armed."""
+        with self._fwd_mu:
+            topic, pred = self._fwd_topic, self._fwd_pred
+        if topic is None or pred is None or not events:
+            return
+        moving = [
+            ev
+            for ev in events
+            if ev.op is not OpKind.TRUNCATE
+            and pred(ev.key.encode("utf-8", "surrogateescape"))
+        ]
+        if moving:
+            self.forward_events(topic, moving)
 
     def _publish(self, payload: bytes) -> bool:
         try:
@@ -398,6 +477,13 @@ class Replicator:
         events = self._clamp_skew(events)
         self.received += len(events)
         get_metrics().inc("replicator.received", len(events))
+        # Remote-apply side of the rebalance double-apply: a moving-range
+        # write that landed on a SIBLING replica arrives here on the group
+        # topic — relay it to the joiner too (the sibling doesn't forward;
+        # only the donor node arms this). Runs before the hold check so a
+        # frame buffered by a concurrent bootstrap still reaches the
+        # joiner.
+        self._range_forward(events)
         if self._lag is not None:
             # Record the publish HWM at DECODE time: a frame held by a
             # bootstrap (or stuck behind a slow apply) reads as lag until

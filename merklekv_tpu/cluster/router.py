@@ -11,8 +11,11 @@ and merge; SCAN/DBSIZE aggregate across all partitions.
 Deliberately THIN: thread-per-connection, one backend connection per
 (client connection, partition), no caching, no pipelining beyond the
 backend client's own. A MOVED answer from a backend (the router's map
-went stale mid-rebalance) refreshes the shared map and retries once —
-the router can serve through a rebalance, it just pays a refresh.
+went stale mid-rebalance) refreshes the shared map and re-routes under
+the bounded PARTITION_MOVED backoff policy; a BUSY answer (the moving
+range's write fence during a live split's flip window) waits the same
+policy out — the router serves straight through a rebalance, it just
+pays refreshes.
 
 Run: ``python -m merklekv_tpu router --port 7400 --seeds host:7001,host:7003``.
 """
@@ -31,8 +34,10 @@ from merklekv_tpu.client import (
     MerkleKVError,
     MovedError,
     ProtocolError,
+    ServerBusyError,
 )
 from merklekv_tpu.cluster.partmap import PartitionMap
+from merklekv_tpu.cluster.retry import PARTITION_MOVED
 from merklekv_tpu.utils.tracing import get_metrics
 
 __all__ = ["PartitionRouter"]
@@ -244,17 +249,37 @@ class PartitionRouter:
             if verb == "PARTMAP":
                 with self._map_mu:
                     return self._map.wire()
-            # One MOVED-healing retry around the real routing work: a
-            # stale router map refreshes and the command re-routes once.
-            try:
-                return self._route(verb, rest, backends)
-            except MovedError as e:
-                m.inc("router.moved_refreshes")
-                for b in backends.values():
-                    b.close()
-                backends.clear()
-                self.refresh_map(min_epoch=e.epoch)
-                return self._route(verb, rest, backends)
+            # Bounded MOVED/BUSY healing around the real routing work
+            # (PARTITION_MOVED retry policy): during a live rebalance a
+            # command can land in the fence window (BUSY — wait it out)
+            # and then on a flipped epoch (MOVED — refresh + re-route),
+            # several times in a row. Each MOVED refreshes the map and
+            # redials; the final attempt's refusal surfaces to the
+            # client, which can apply its own policy.
+            attempt = 0
+            while True:
+                try:
+                    return self._route(verb, rest, backends)
+                except MovedError as e:
+                    if attempt + 1 >= (PARTITION_MOVED.attempts or 1):
+                        raise
+                    m.inc("router.moved_refreshes")
+                    for b in backends.values():
+                        b.close()
+                    backends.clear()
+                    time.sleep(PARTITION_MOVED.backoff(attempt))
+                    attempt += 1
+                    self.refresh_map(min_epoch=e.epoch)
+                except ServerBusyError:
+                    if attempt + 1 >= (PARTITION_MOVED.attempts or 1):
+                        raise
+                    m.inc("router.busy_retries")
+                    time.sleep(PARTITION_MOVED.backoff(attempt))
+                    attempt += 1
+                    try:
+                        self.refresh_map()
+                    except ClientConnectionError:
+                        pass  # retry against the current map
         except MovedError as e:
             return f"ERROR MOVED {e.partition} {e.epoch}\r\n"
         except ProtocolError as e:

@@ -611,6 +611,46 @@ void mkv_server_set_partition(void* h, unsigned long long epoch,
       epoch, uint32_t(count), uint32_t(owned));
 }
 
+// Split-map generalization (live rebalancing): install the full split-tree
+// ownership table — partition p owns (roots[p], depths[p], paths[p]) under
+// hash base `base` (cluster/partmap.py is the authoritative spec). A
+// boot-shaped table (base == count, assignment i == (i,0,0)) collapses to
+// the legacy modulo guard. The three arrays must each hold `count` entries.
+void mkv_server_set_partition_map(void* h, unsigned long long epoch,
+                                  long long base, long long count,
+                                  long long owned, const unsigned int* roots,
+                                  const unsigned int* depths,
+                                  const unsigned long long* paths) {
+  if (count < 0) count = 0;
+  if (owned < 0) owned = 0;
+  if (base < 0) base = 0;
+  std::vector<mkv::PartAssignment> assigns;
+  assigns.reserve(size_t(count));
+  for (long long i = 0; i < count; ++i) {
+    assigns.push_back(mkv::PartAssignment{uint32_t(roots[i]),
+                                          uint32_t(depths[i]),
+                                          uint64_t(paths[i])});
+  }
+  static_cast<ServerHandle*>(h)->server->set_partition_map(
+      epoch, uint32_t(base), uint32_t(count), uint32_t(owned),
+      std::move(assigns));
+}
+
+// Rebalance write fence: writes whose key falls inside the split-tree cell
+// (root, depth, path) under `base` answer the retryable "ERROR BUSY
+// rebalance retry" until the fence clears. Reads keep serving.
+void mkv_server_set_partition_fence(void* h, long long base, long long root,
+                                    long long depth,
+                                    unsigned long long path) {
+  static_cast<ServerHandle*>(h)->server->set_partition_fence(
+      uint32_t(base < 0 ? 0 : base), uint32_t(root < 0 ? 0 : root),
+      uint32_t(depth < 0 ? 0 : depth), uint64_t(path));
+}
+
+void mkv_server_clear_partition_fence(void* h) {
+  static_cast<ServerHandle*>(h)->server->clear_partition_fence();
+}
+
 // Change-event queue depth (staged-but-undrained events) — the
 // replication/WAL feed's backlog gauge.
 long long mkv_server_events_depth(void* h) {

@@ -265,6 +265,9 @@ ParseResult parse_command(const std::string& line) {
     if (u == "PROFILE") {
       return err("PROFILE requires a positive duration in seconds");
     }
+    if (u == "REBALANCE") {
+      return err("REBALANCE command requires a subcommand");
+    }
     if (u == "TRACE") {
       c.verb = Verb::Trace;
       c.amount = 8;  // bare TRACE: a useful default window
@@ -303,6 +306,15 @@ ParseResult parse_command(const std::string& line) {
     }
     Command c;
     c.verb = Verb::Dbsize;
+    return ok(std::move(c));
+  }
+  if (u == "REBALANCE") {
+    // Control-plane relay: the subcommand tail is opaque here (the
+    // Python state machine parses it); only the character rules apply.
+    if (auto e = bad_char(rest, "subcommand")) return err(*e);
+    Command c;
+    c.verb = Verb::Rebalance;
+    c.message = rest;
     return ok(std::move(c));
   }
   if (u == "PING" || u == "ECHO") {

@@ -73,6 +73,14 @@ enum class Verb {
   // Served by the cluster control plane; a node without one answers ERROR
   // (the capability signal that the deployment is not partitioned).
   PartMap,
+  // Live resharding control plane: "REBALANCE <subcommand> [...]" (SPLIT/
+  // JOIN/FORWARD/FENCE/COMMIT/ABORT/STATUS) is relayed verbatim to the
+  // cluster control plane, where the rebalance state machine lives
+  // (cluster/rebalance.py). The raw argument tail rides in cmd.message —
+  // the native layer validates nothing past the verb, exactly like the
+  // other control-plane relays, so the wire grammar can evolve without a
+  // native rebuild. A node without a cluster plane answers ERROR.
+  Rebalance,
 };
 
 enum class ReplicateAction { Enable, Disable, Status };

@@ -85,6 +85,7 @@ const char* verb_name(Verb v) {
     case Verb::Profile: return "PROFILE";
     case Verb::Flight: return "FLIGHT";
     case Verb::PartMap: return "PARTMAP";
+    case Verb::Rebalance: return "REBALANCE";
   }
   return "CMD";
 }
@@ -136,25 +137,51 @@ bool is_write_verb(Verb v) {
   }
 }
 
-// key -> partition id: first 8 bytes of SHA-256(key) as a big-endian u64,
-// mod the partition count. MUST stay bit-identical to
-// cluster/partmap.py::partition_of — the smart clients, the router, and
-// this guard all route with the same function or MOVED ping-pongs forever.
-uint32_t partition_of_key(const std::string& key, uint32_t count) {
+// key -> routing hash: first 8 bytes of SHA-256(key) as a big-endian u64.
+// MUST stay bit-identical to cluster/partmap.py::hash_of_key — the smart
+// clients, the router, and this guard all route from the same hash or
+// MOVED ping-pongs forever.
+uint64_t routing_hash(const std::string& key) {
   uint8_t d[32];
   sha256(key.data(), key.size(), d);
   uint64_t v = 0;
   for (int i = 0; i < 8; ++i) v = (v << 8) | d[i];
-  return uint32_t(v % count);
+  return v;
 }
 
+// The guard's per-dispatch view of the partition state: identity atomics
+// plus the (possibly null) split table, loaded ONCE per command so every
+// key in a multi-key verb is judged against the same map generation.
+struct PartView {
+  uint32_t count = 0;
+  uint32_t owned = 0;
+  const PartTable* table = nullptr;  // null = legacy h % count
+
+  uint32_t owner_of(const std::string& key) const {
+    const uint64_t h = routing_hash(key);
+    if (table == nullptr) return uint32_t(h % count);
+    const uint64_t root = h % table->base;
+    const uint64_t sub = h / table->base;
+    for (uint32_t pid = 0; pid < table->assigns.size(); ++pid) {
+      const PartAssignment& a = table->assigns[pid];
+      if (a.root == root &&
+          (sub & ((uint64_t(1) << a.depth) - 1)) == a.path) {
+        return pid;
+      }
+    }
+    // Unreachable against a validated map (the Python layer proves the
+    // assignments tile the hash space before installing); serving beats
+    // bricking dispatch if an uncovered hash ever appears.
+    return owned;
+  }
+};
+
 // First FOREIGN partition addressed by this command, or -1 when every key
-// (and any pt= tree address) belongs to `owned`. Only key-bearing data
+// (and any pt= tree address) belongs to `pv.owned`. Only key-bearing data
 // verbs participate: keyless verbs (PING/STATS/SCAN/TRUNCATE/...) are
 // whole-node operations, and the management/anti-entropy plane must never
 // be refused by routing (it repairs what routing mistakes leave behind).
-int64_t foreign_partition(const Command& cmd, uint32_t count,
-                          uint32_t owned) {
+int64_t foreign_partition(const Command& cmd, const PartView& pv) {
   switch (cmd.verb) {
     case Verb::Get:
     case Verb::Set:
@@ -163,21 +190,21 @@ int64_t foreign_partition(const Command& cmd, uint32_t count,
     case Verb::Decrement:
     case Verb::Append:
     case Verb::Prepend: {
-      uint32_t p = partition_of_key(cmd.key, count);
-      return p == owned ? -1 : int64_t(p);
+      uint32_t p = pv.owner_of(cmd.key);
+      return p == pv.owned ? -1 : int64_t(p);
     }
     case Verb::Exists:
     case Verb::MultiGet:
       for (const auto& k : cmd.keys) {
-        uint32_t p = partition_of_key(k, count);
-        if (p != owned) return int64_t(p);
+        uint32_t p = pv.owner_of(k);
+        if (p != pv.owned) return int64_t(p);
       }
       return -1;
     case Verb::MultiSet:
       for (const auto& [k, v] : cmd.pairs) {
         (void)v;
-        uint32_t p = partition_of_key(k, count);
-        if (p != owned) return int64_t(p);
+        uint32_t p = pv.owner_of(k);
+        if (p != pv.owned) return int64_t(p);
       }
       return -1;
     case Verb::Hash:
@@ -185,12 +212,43 @@ int64_t foreign_partition(const Command& cmd, uint32_t count,
       // Partition-scoped tree addressing: a pt= token naming a partition
       // this node does not own is a stale-map read — MOVED, never a
       // silently different partition's tree into the caller's walk.
-      if (cmd.partition >= 0 && uint64_t(cmd.partition) != owned) {
+      if (cmd.partition >= 0 && uint64_t(cmd.partition) != pv.owned) {
         return cmd.partition;
       }
       return -1;
     default:
       return -1;
+  }
+}
+
+// True iff `key` falls inside the fenced (moving) range.
+bool key_in_fence(const std::string& key, const PartFence& f) {
+  const uint64_t h = routing_hash(key);
+  if (h % f.base != f.root) return false;
+  return ((h / f.base) & ((uint64_t(1) << f.depth) - 1)) == f.path;
+}
+
+// First fenced key of a WRITE verb, or false. Reads stay open (the donor's
+// copy is authoritative until the flip — writes being refused is exactly
+// what keeps it authoritative); keyless writes (TRUNCATE/FLUSHDB) are
+// whole-node admin actions outside the fence's scope.
+bool fence_blocks(const Command& cmd, const PartFence& f) {
+  switch (cmd.verb) {
+    case Verb::Set:
+    case Verb::Delete:
+    case Verb::Increment:
+    case Verb::Decrement:
+    case Verb::Append:
+    case Verb::Prepend:
+      return key_in_fence(cmd.key, f);
+    case Verb::MultiSet:
+      for (const auto& [k, v] : cmd.pairs) {
+        (void)v;
+        if (key_in_fence(k, f)) return true;
+      }
+      return false;
+    default:
+      return false;
   }
 }
 
@@ -862,6 +920,58 @@ void Server::set_cluster_callback(ClusterCallback cb) {
   cluster_cb_ = std::move(cb);
 }
 
+void Server::set_partition_map(uint64_t epoch, uint32_t base, uint32_t count,
+                               uint32_t owned,
+                               std::vector<PartAssignment> assigns) {
+  // A boot-shaped map (base == count, assignment i == (i, 0, 0)) takes
+  // the legacy null-table path: owner_of stays the one-modulo fast guard
+  // and STATS stays byte-identical to the pre-split format.
+  bool trivial = (base == count && assigns.size() == count);
+  if (trivial) {
+    for (uint32_t i = 0; i < count; ++i) {
+      if (assigns[i].root != i || assigns[i].depth != 0 ||
+          assigns[i].path != 0) {
+        trivial = false;
+        break;
+      }
+    }
+  }
+  const PartTable* published = nullptr;
+  if (!trivial && base > 0 && assigns.size() == count) {
+    auto t = std::make_unique<PartTable>();
+    t->base = base;
+    t->assigns = std::move(assigns);
+    published = t.get();
+    std::lock_guard lk(part_mu_);
+    part_retired_.push_back(std::move(t));
+  }
+  // Publication order: identity first, table next, count LAST — count is
+  // the guard's enable bit, so a command can never observe "guard on"
+  // before the rest of the new generation is visible. A command racing
+  // the swap may judge one key against the outgoing generation; it then
+  // answers MOVED with the NEW epoch, which is exactly the refresh signal
+  // the clients heal through.
+  part_epoch_.store(epoch, std::memory_order_release);
+  part_owned_.store(owned, std::memory_order_release);
+  part_table_.store(published, std::memory_order_release);
+  part_count_.store(count, std::memory_order_release);
+}
+
+void Server::set_partition_fence(uint32_t base, uint32_t root, uint32_t depth,
+                                 uint64_t path) {
+  auto f = std::make_unique<PartFence>();
+  f->base = base;
+  f->root = root;
+  f->depth = depth;
+  f->path = path;
+  const PartFence* published = f.get();
+  {
+    std::lock_guard lk(part_mu_);
+    fence_retired_.push_back(std::move(f));
+  }
+  part_fence_.store(published, std::memory_order_release);
+}
+
 bool Server::refuse_admission(int fd) {
   // Admission control: past max_connections (or while draining) the
   // excess accept is answered BUSY and closed RIGHT HERE — it never
@@ -979,12 +1089,15 @@ std::string Server::stats_text() {
   // partition identity lines (emitted only while partitioned, so an
   // unpartitioned node's STATS stays byte-compatible with older parsers).
   add("moved_commands", ld(stats_.moved_commands));
+  add("fenced_commands", ld(stats_.fenced_commands));
   {
     const uint32_t pcount = part_count_.load(std::memory_order_acquire);
     if (pcount > 0) {
       add("partition_count", pcount);
       add("partition_id", part_owned_.load(std::memory_order_acquire));
       add("partition_epoch", part_epoch_.load(std::memory_order_acquire));
+      const PartTable* t = part_table_.load(std::memory_order_acquire);
+      if (t != nullptr) add("partition_base", t->base);
     }
   }
   // Zero-copy serving plane: the slab account (live/pinned bytes feed the
@@ -1119,13 +1232,29 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
   // clients; docs/PROTOCOL.md "Partitioned cluster mode").
   const uint32_t pcount = part_count_.load(std::memory_order_acquire);
   if (pcount > 0) {
-    const int64_t fp = foreign_partition(
-        cmd, pcount, part_owned_.load(std::memory_order_acquire));
+    PartView pv;
+    pv.count = pcount;
+    pv.owned = part_owned_.load(std::memory_order_acquire);
+    pv.table = part_table_.load(std::memory_order_acquire);
+    const int64_t fp = foreign_partition(cmd, pv);
     if (fp >= 0) {
       stats_.moved_commands.fetch_add(1, std::memory_order_relaxed);
       out.lit("ERROR MOVED " + std::to_string(fp) + " " +
               std::to_string(part_epoch_.load(std::memory_order_acquire)) +
               "\r\n");
+      return;
+    }
+  }
+  // Rebalance write fence (the flip window of a live split): writes into
+  // the moving range answer a RETRYABLE BUSY — the same backoff contract
+  // as shedding, so every existing client retry loop already heals it.
+  // Checked after the MOVED guard (a foreign key re-routes, it does not
+  // wait) and before the degradation ladder (the fence is stricter).
+  {
+    const PartFence* fence = part_fence_.load(std::memory_order_acquire);
+    if (fence != nullptr && fence_blocks(cmd, *fence)) {
+      stats_.fenced_commands.fetch_add(1, std::memory_order_relaxed);
+      out.lit("ERROR BUSY rebalance retry\r\n");
       return;
     }
   }
@@ -1310,6 +1439,27 @@ void Server::dispatch(const Command& cmd, OutQueue& out, bool* close_conn) {
         }
       }
       out.lit("ERROR partition map unavailable\r\n");
+      return;
+    }
+    case Verb::Rebalance: {
+      // Live resharding control verb: the whole line is relayed to the
+      // cluster control plane, where the rebalance state machine lives.
+      // Deliberately outside every gate — a donor mid-split may be
+      // shedding, a joiner is LOADING, and both must still take
+      // COMMIT/ABORT or the session can never finish either way.
+      ClusterCallback cb;
+      {
+        std::lock_guard lk(cb_mu_);
+        cb = cluster_cb_;
+      }
+      if (cb) {
+        std::string resp = cb("REBALANCE " + cmd.message);
+        if (!resp.empty()) {
+          out.payload(std::move(resp));
+          return;
+        }
+      }
+      out.lit("ERROR rebalance unavailable\r\n");
       return;
     }
     case Verb::Peers: {

@@ -265,6 +265,28 @@ enum class DegradeReason : int {
   kAdmin = 4,
 };
 
+// Split-tree partition ownership (live rebalancing; cluster/partmap.py is
+// the authoritative spec). One assignment per partition id; the table is
+// published wholesale on every epoch change.
+struct PartAssignment {
+  uint32_t root = 0;
+  uint32_t depth = 0;
+  uint64_t path = 0;
+};
+
+struct PartTable {
+  uint32_t base = 0;  // boot partition count: h % base picks the root
+  std::vector<PartAssignment> assigns;  // index = partition id
+};
+
+// One armed rebalance write fence: the moving range, as a split-tree cell.
+struct PartFence {
+  uint32_t base = 0;
+  uint32_t root = 0;
+  uint32_t depth = 0;
+  uint64_t path = 0;
+};
+
 class IoWorker;
 
 class Server {
@@ -380,9 +402,32 @@ class Server {
   // rides in the answer so the client knows which map generation refused
   // it. count 0 = unpartitioned (the guard is off, default).
   void set_partition(uint64_t epoch, uint32_t count, uint32_t owned) {
+    part_table_.store(nullptr, std::memory_order_release);
     part_epoch_.store(epoch, std::memory_order_release);
     part_owned_.store(owned, std::memory_order_release);
     part_count_.store(count, std::memory_order_release);
+  }
+  // Split-map generalization (live rebalancing): ownership follows the
+  // split tree of cluster/partmap.py — with h the routing hash above,
+  // root = h % base and sub = h / base, partition p owns its key iff
+  // roots[p] == root and (sub & ((1 << depths[p]) - 1)) == paths[p]. The
+  // boot map (base == count, all depths 0) reduces to h % count, which is
+  // why set_partition() stays the legacy fast path (null table). The
+  // table is swapped atomically; superseded tables are retired, never
+  // freed mid-flight (bounded by the handful of epoch changes a process
+  // ever sees).
+  void set_partition_map(uint64_t epoch, uint32_t base, uint32_t count,
+                         uint32_t owned,
+                         std::vector<PartAssignment> assigns);
+  // Rebalance write fence: while armed, key-bearing WRITE verbs whose key
+  // falls inside (root, depth, path) under base answer the retryable
+  // "ERROR BUSY rebalance retry" — the flip window's write stall. Reads
+  // keep serving (donor data stays current precisely BECAUSE the writes
+  // are refused), so fence != unavailability for the moving range.
+  void set_partition_fence(uint32_t base, uint32_t root, uint32_t depth,
+                           uint64_t path);
+  void clear_partition_fence() {
+    part_fence_.store(nullptr, std::memory_order_release);
   }
   uint32_t partition_count() const {
     return part_count_.load(std::memory_order_acquire);
@@ -449,6 +494,15 @@ class Server {
   std::atomic<uint64_t> part_epoch_{0};
   std::atomic<uint32_t> part_count_{0};
   std::atomic<uint32_t> part_owned_{0};
+  // Split-map table + rebalance fence (null = legacy h % count / no
+  // fence). Readers take one acquire load on the request path; writers
+  // build off-path and retire superseded objects instead of freeing them
+  // under readers' feet (part_mu_ guards only the retire lists).
+  std::atomic<const PartTable*> part_table_{nullptr};
+  std::atomic<const PartFence*> part_fence_{nullptr};
+  std::mutex part_mu_;
+  std::vector<std::unique_ptr<const PartTable>> part_retired_;
+  std::vector<std::unique_ptr<const PartFence>> fence_retired_;
   std::atomic<bool> zero_copy_{true};   // GET/MGET block path vs compat copy
   bool reuseport_live_ = false;         // accept sharding resolved at start
   std::atomic<uint64_t> slow_threshold_us_{0};  // 0 = slow log off

@@ -92,6 +92,12 @@ struct ServerStats {
   //                               cluster mode (never a silent wrong-node
   //                               read/write).
   std::atomic<uint64_t> moved_commands{0};
+  //   fenced_commands           — write verbs answered the retryable
+  //                               "ERROR BUSY rebalance retry" because the
+  //                               key fell inside a rebalance write fence
+  //                               (the brief flip window of a live split;
+  //                               reads keep serving throughout).
+  std::atomic<uint64_t> fenced_commands{0};
 
   // Zero-copy serving plane (extension lines):
   //   serve_zero_copy     — values (> OutQueue::kInlinePayload) served as
@@ -154,6 +160,7 @@ struct ServerStats {
       case Verb::Profile: management_commands++; break;
       case Verb::Flight: management_commands++; break;
       case Verb::PartMap: management_commands++; break;
+      case Verb::Rebalance: management_commands++; break;
       case Verb::Sync:
       case Verb::SnapMeta:
       case Verb::SnapChunk: sync_commands++; break;

@@ -209,6 +209,16 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_longlong,
         ctypes.c_longlong,
     ]
+    lib.mkv_server_set_partition_map.argtypes = [
+        ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_longlong, P(ctypes.c_uint),
+        P(ctypes.c_uint), P(ctypes.c_ulonglong),
+    ]
+    lib.mkv_server_set_partition_fence.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_ulonglong,
+    ]
+    lib.mkv_server_clear_partition_fence.argtypes = [ctypes.c_void_p]
     lib.mkv_install_crash_marker.argtypes = [ctypes.c_char_p]
     lib.mkv_server_drain_events.argtypes = [
         ctypes.c_void_p, ctypes.c_int, P(ctypes.c_void_p), P(ctypes.c_longlong),
@@ -752,6 +762,47 @@ class NativeServer:
         wrong node. ``count`` 0 disables the guard (the default)."""
         if self._h:
             self._lib.mkv_server_set_partition(self._h, epoch, count, owned)
+
+    def set_partition_map(
+        self,
+        epoch: int,
+        base: int,
+        owned: int,
+        assignments: list[tuple[int, int, int]],
+    ) -> None:
+        """Install a SPLIT-TREE partition map in the native guard (the
+        live-rebalancing generalization of :meth:`set_partition`):
+        partition ``p`` owns the hash-space cell ``assignments[p] =
+        (root, depth, path)`` under ``base`` (see
+        cluster/partmap.py — routing stays bit-identical across guard,
+        clients, and router). A boot-shaped map degenerates to the
+        legacy modulo guard natively."""
+        if not self._h:
+            return
+        n = len(assignments)
+        roots = (ctypes.c_uint * n)(*[a[0] for a in assignments])
+        depths = (ctypes.c_uint * n)(*[a[1] for a in assignments])
+        paths = (ctypes.c_ulonglong * n)(*[a[2] for a in assignments])
+        self._lib.mkv_server_set_partition_map(
+            self._h, epoch, base, n, owned, roots, depths, paths
+        )
+
+    def set_partition_fence(
+        self, base: int, root: int, depth: int, path: int
+    ) -> None:
+        """Arm the rebalance write fence: writes whose key falls in the
+        split-tree cell ``(root, depth, path)`` under ``base`` answer the
+        retryable ``ERROR BUSY rebalance retry`` until
+        :meth:`clear_partition_fence` — the (brief) flip window of a live
+        split. Reads keep serving throughout."""
+        if self._h:
+            self._lib.mkv_server_set_partition_fence(
+                self._h, base, root, depth, path
+            )
+
+    def clear_partition_fence(self) -> None:
+        if self._h:
+            self._lib.mkv_server_clear_partition_fence(self._h)
 
     def set_slow_threshold(self, us: int) -> None:
         """Slow-command log threshold in microseconds (0 = off): a

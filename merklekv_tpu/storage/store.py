@@ -815,6 +815,34 @@ class DurableStore:
         with self._pin_mu:
             self._pins[seq] = time.monotonic()
 
+    def refresh_pin(self, seq: Optional[int] = None) -> None:
+        """Re-stamp snapshot ``seq``'s retention pin — or EVERY live pin
+        when ``seq`` is None — from a long-lived transfer session's
+        heartbeat. SNAPMETA/SNAPCHUNK reads refresh pins as a side effect,
+        but a THROTTLED rebalance transfer can legitimately go quiet for
+        longer than ``_PIN_TTL_S`` between chunks (the joiner paces itself
+        against live write load) — the donor-side rebalance session
+        heartbeats this instead, so the artifact outlives any pause
+        shorter than the session itself while a dead session still
+        releases it after the TTL."""
+        if seq is not None:
+            self._pin(seq)
+            return
+        now = time.monotonic()
+        with self._pin_mu:
+            for s in self._pins:
+                self._pins[s] = now
+
+    def request_snapshot(self) -> None:
+        """Ask the background ticker for a re-anchor snapshot on its next
+        tick (no-op without a ticker — embedded shapes call
+        :meth:`snapshot_now` directly). Used after a rebalance drops the
+        moved range with quiet deletes: the drop is unjournaled by design
+        (the new map's guard plus the boot-time foreign-key sweep make the
+        range unreachable), so the next snapshot must capture the
+        post-drop keyspace to keep recovery O(owned keys)."""
+        self._snapshot_requested = True
+
     # donor_meta sentinel: no artifact yet, but one is being built in the
     # background — the joiner should retry shortly instead of degrading.
     BUILDING = "building"
