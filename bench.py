@@ -2393,6 +2393,387 @@ def bench_rebalance_live_split(
     return record
 
 
+def _start_mini_partition_cluster(
+    partitions: int,
+    broker_port: int = 0,
+    topic: str = "",
+    via_proxy_delay_s: float = 0.0,
+):
+    """In-process P-partition x 1-replica backend for the router benches:
+    NativeEngine/NativeServer + ClusterNode per partition. With
+    ``via_proxy_delay_s`` > 0 each node is fronted by a FaultInjector
+    delay proxy (the emulated cross-host partition RTT) and the partition
+    map announces the PROXY addresses — routers and smart clients then
+    pay the emulated network to reach a partition, exactly like remote
+    backends, while a router cache hit answers before the proxy hop.
+    Returns (addrs, closers) where addrs are the routable addresses."""
+    import socket as _socket
+
+    from merklekv_tpu.cluster.node import ClusterNode
+    from merklekv_tpu.config import Config
+    from merklekv_tpu.native_bindings import NativeEngine, NativeServer
+
+    node_ports = []
+    socks = []
+    for _ in range(partitions):
+        s = _socket.socket()
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        node_ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+
+    # closers run FORWARD at teardown: nodes stop before their native
+    # server/engine close, and the delay proxies outlive the nodes.
+    closers = []
+    proxy_closers = []
+    proxies = []
+    if via_proxy_delay_s > 0:
+        from merklekv_tpu.testing.faults import FaultInjector
+
+        for p in range(partitions):
+            inj = FaultInjector("127.0.0.1", node_ports[p], seed=17 + p)
+            inj.set_faults(
+                "s2c", delay=(via_proxy_delay_s, via_proxy_delay_s)
+            )
+            proxies.append(inj)
+            proxy_closers.append(inj.close)
+        addrs = [f"127.0.0.1:{inj.port}" for inj in proxies]
+    else:
+        addrs = [f"127.0.0.1:{p}" for p in node_ports]
+    spec = ";".join(f"{p}={addrs[p]}" for p in range(partitions))
+
+    for p in range(partitions):
+        cfg = Config()
+        cfg.host = "127.0.0.1"
+        cfg.port = node_ports[p]
+        cfg.cluster.partitions = partitions
+        cfg.cluster.partition_id = p
+        cfg.cluster.partition_map = spec
+        if broker_port:
+            cfg.replication.enabled = True
+            cfg.replication.mqtt_broker = "127.0.0.1"
+            cfg.replication.mqtt_port = broker_port
+            cfg.replication.topic_prefix = topic
+        cfg.anti_entropy.enabled = False
+        eng = NativeEngine("mem")
+        srv = NativeServer(eng, "127.0.0.1", node_ports[p])
+        srv.start()
+        node = ClusterNode(cfg, eng, srv)
+        node.start()
+        closers.append(node.stop)
+        closers.append(srv.close)
+        closers.append(eng.close)
+    closers.extend(proxy_closers)
+    return addrs, closers
+
+
+def bench_router_pipelined_throughput(
+    n_conns: int = 64, depth: int = 32, bursts: int = 20
+) -> dict:
+    """Request-plane io A/B (ISSUE 17 tentpole evidence).
+
+    The many_conn_throughput 64-conn pipelined burst rig pointed at the
+    ROUTING hop: a 2-partition in-process native cluster behind (a) the
+    pooled epoll request plane (merklekv_tpu/requestplane/ — pipelined
+    client parsing, one writev per burst, pipelined per-partition
+    upstream fan-out; cache OFF so this measures the io plane, not the
+    cache) and (b) the legacy thread-per-connection thin router
+    (cluster/router.py: one blocking upstream round trip per command),
+    same pre-built byte load both ways. Both routers are Python and run
+    in-process, so GIL pressure and driver overhead are common-mode —
+    the measured ratio is the architecture's. value = pooled ops/s
+    ("/s" reads up-good in tools/bench_gate.py); the legacy baseline and
+    speedup ride as side fields, target >= 3x on CPU."""
+    import socket
+    import threading
+
+    from merklekv_tpu.client import MerkleKVClient
+    from merklekv_tpu.cluster.router import PartitionRouter
+    from merklekv_tpu.requestplane import RequestPlaneRouter
+
+    val = b"v" * 64
+    n_keys = 1024
+    addrs, closers = _start_mini_partition_cluster(2)
+    try:
+        # Seed once through a temporary pooled router (it routes).
+        seeder = RequestPlaneRouter("127.0.0.1", 0, addrs, workers=2).start()
+        with MerkleKVClient("127.0.0.1", seeder.port) as c:
+            for base in range(0, n_keys, 128):
+                c.mset({
+                    f"rp{i:05d}": "v" * 64
+                    for i in range(base, base + 128)
+                })
+        seeder.stop()
+
+        payloads = []
+        for ci in range(n_conns):
+            cmds = []
+            for j in range(depth):
+                k = b"rp%05d" % ((ci * 131 + j * 17) % n_keys)
+                if j % 2:
+                    cmds.append(b"GET " + k + b"\r\n")
+                else:
+                    cmds.append(b"SET " + k + b" " + val + b"\r\n")
+            payloads.append(b"".join(cmds))
+
+        def drive(port: int) -> tuple[float, float]:
+            socks = [
+                socket.create_connection(("127.0.0.1", port), timeout=30)
+                for _ in range(n_conns)
+            ]
+            burst_ns: list[list[int]] = [[] for _ in range(n_conns)]
+            n_threads = min(8, n_conns)
+            per = (n_conns + n_threads - 1) // n_threads
+            start_evt = threading.Event()
+            errors: list[BaseException] = []
+
+            def driver(t: int) -> None:
+                mine = range(t * per, min((t + 1) * per, n_conns))
+                buf = bytearray(1 << 16)
+                try:
+                    start_evt.wait()
+                    for _ in range(bursts):
+                        t0s = {}
+                        for ci in mine:
+                            t0s[ci] = time.perf_counter_ns()
+                            socks[ci].sendall(payloads[ci])
+                        for ci in mine:
+                            got = 0
+                            while got < depth:
+                                n = socks[ci].recv_into(buf)
+                                if n == 0:
+                                    raise ConnectionError("router closed")
+                                got += buf.count(b"\n", 0, n)
+                            burst_ns[ci].append(
+                                time.perf_counter_ns() - t0s[ci]
+                            )
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=driver, args=(t,), daemon=True)
+                for t in range(n_threads)
+            ]
+            for th in threads:
+                th.start()
+            t0 = time.perf_counter()
+            start_evt.set()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            for s in socks:
+                s.close()
+            if errors:
+                raise errors[0]
+            total = n_conns * depth * bursts
+            all_ns = sorted(ns for per_c in burst_ns for ns in per_c)
+            p99_ms = (
+                all_ns[min(int(0.99 * (len(all_ns) - 1)), len(all_ns) - 1)]
+                / 1e6
+            )
+            return total / dt, p99_ms
+
+        pooled = RequestPlaneRouter("127.0.0.1", 0, addrs).start()
+        try:
+            pooled_rate, pooled_p99_ms = drive(pooled.port)
+            pooled_workers = len(pooled._workers)
+        finally:
+            pooled.stop()
+        legacy = PartitionRouter("127.0.0.1", 0, addrs).start()
+        try:
+            legacy_rate, legacy_p99_ms = drive(legacy.port)
+        finally:
+            legacy.stop()
+    finally:
+        for fn in closers:
+            try:
+                fn()
+            except Exception:
+                pass
+    speedup = pooled_rate / max(legacy_rate, 1e-9)
+    return {
+        "metric": "router_pipelined_throughput",
+        "value": round(pooled_rate, 1),
+        "unit": f"ops/s ({n_conns} conns x pipelined GET/SET via router, "
+                f"depth {depth})",
+        "conns": n_conns,
+        "depth": depth,
+        "bursts_per_conn": bursts,
+        "io_workers": pooled_workers,
+        "pooled_ops_per_s": round(pooled_rate, 1),
+        "pooled_burst_p99_ms": round(pooled_p99_ms, 3),
+        "legacy_ops_per_s": round(legacy_rate, 1),
+        "legacy_burst_p99_ms": round(legacy_p99_ms, 3),
+        "speedup_x": round(speedup, 2),
+        "target": 3.0,
+        "target_met": speedup >= 3.0,
+    }
+
+
+def bench_router_hotkey_skew(
+    duration_s: float = 1.2,
+    n_keys: int = 512,
+    readers: int = 8,
+    rtt_ms: float = 4.0,
+    workers: int = 8,
+    cache_entries: int = 192,
+) -> dict:
+    """Hot-key Zipfian A/B: request plane vs smart client (ISSUE 17).
+
+    A 2-partition cluster where every partition sits behind a
+    FaultInjector delay proxy (~4 ms added per forwarded chunk — the
+    emulated cross-host partition RTT; in-process backends would
+    otherwise answer faster than any cache could). The proxy applies its
+    delay serially per connection, so the router runs with 8 io workers:
+    each worker owns its own upstream connection per partition and
+    concurrent misses pay the emulated RTT in parallel, exactly as the
+    smart client's per-reader connections do. The SAME closed-loop
+    read-mostly load (63/64 GET, 1/64 SET) runs through (a) the smart
+    client, which pays the emulated RTT on every op, and (b) the request
+    plane with a lease cache capped at 192 entries (~3/8 of the keyspace
+    — a hot-key shield, not a dataset mirror) fed by the cluster's
+    replication topics, at two key distributions: uniform over 512 keys,
+    and Zipf(0.5) — the head key carries ~11x its uniform share ("10x
+    skew"). Acceptance: at uniform the router adds < 15% GET p99 over
+    the smart client (p99 is the miss path: RTT + hop); at skew the
+    router WINS throughput — the resident Zipf head answers at the
+    router without touching the owning partition. value = the router's
+    skewed aggregate GET rate ("/s" up-good); all four corners ride as
+    side fields."""
+    import threading
+    import uuid as _uuid
+
+    from merklekv_tpu.client import MerkleKVClient, PartitionedClient
+    from merklekv_tpu.cluster.transport import TcpBroker
+    from merklekv_tpu.requestplane import RequestPlaneRouter
+
+    broker = TcpBroker()
+    topic = f"bench-skew-{_uuid.uuid4().hex[:8]}"
+    addrs, closers = _start_mini_partition_cluster(
+        2, broker_port=broker.port, topic=topic,
+        via_proxy_delay_s=rtt_ms / 1000.0
+    )
+    closers.append(broker.close)
+    router = None
+    try:
+        router = RequestPlaneRouter(
+            "127.0.0.1", 0, addrs,
+            workers=workers,
+            cache_bytes=cache_entries * 170,
+            cache_max_age_ms=2000.0,
+            broker="127.0.0.1", broker_port=broker.port,
+            topic_prefix=topic,
+        ).start()
+        with PartitionedClient(addrs) as seed_c:
+            for i in range(n_keys):
+                seed_c.set(f"hk{i:04d}", "w" * 64)
+
+        # Zipf(theta) CDF over ranks 1..n; theta=0 is uniform.
+        def cdf(theta: float) -> list[float]:
+            w = [1.0 / ((i + 1) ** theta) for i in range(n_keys)]
+            tot = sum(w)
+            acc, out = 0.0, []
+            for x in w:
+                acc += x
+                out.append(acc / tot)
+            return out
+
+        import bisect
+        import random as _random
+
+        def run_side(make_client, theta: float) -> tuple[float, float]:
+            dist = cdf(theta)
+            stop = threading.Event()
+            lat_ns: list[list[int]] = [[] for _ in range(readers)]
+            errors: list[BaseException] = []
+
+            def reader(t: int) -> None:
+                rng = _random.Random(1000 + t)
+                try:
+                    with make_client() as c:
+                        i = 0
+                        while not stop.is_set():
+                            key = f"hk{bisect.bisect_left(dist, rng.random()):04d}"
+                            if i % 64 == 63:
+                                c.set(key, "w" * 64)
+                            else:
+                                t0 = time.perf_counter_ns()
+                                c.get(key)
+                                lat_ns[t].append(
+                                    time.perf_counter_ns() - t0
+                                )
+                            i += 1
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=reader, args=(t,), daemon=True)
+                for t in range(readers)
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            time.sleep(duration_s)
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+            dt = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            all_ns = sorted(ns for per_t in lat_ns for ns in per_t)
+            if not all_ns:
+                raise RuntimeError("no reads completed")
+            p99_ms = (
+                all_ns[min(int(0.99 * (len(all_ns) - 1)), len(all_ns) - 1)]
+                / 1e6
+            )
+            return len(all_ns) / dt, p99_ms
+
+        def smart():
+            return PartitionedClient(addrs)
+
+        def via_router():
+            return MerkleKVClient("127.0.0.1", router.port)
+
+        uni_smart_rate, uni_smart_p99 = run_side(smart, 0.0)
+        uni_router_rate, uni_router_p99 = run_side(via_router, 0.0)
+        skew_smart_rate, skew_smart_p99 = run_side(smart, 0.5)
+        skew_router_rate, skew_router_p99 = run_side(via_router, 0.5)
+    finally:
+        if router is not None:
+            router.stop()
+        for fn in closers:
+            try:
+                fn()
+            except Exception:
+                pass
+    overhead_pct = (uni_router_p99 / max(uni_smart_p99, 1e-9) - 1.0) * 100
+    wins = skew_router_rate > skew_smart_rate
+    return {
+        "metric": "router_hotkey_skew",
+        "value": round(skew_router_rate, 1),
+        "unit": f"gets/s (router, Zipf(0.5) over {n_keys} keys, "
+                f"{rtt_ms:g}ms emulated partition RTT)",
+        "readers": readers,
+        "duration_s": duration_s,
+        "emulated_rtt_ms": rtt_ms,
+        "uniform_smart_gets_per_s": round(uni_smart_rate, 1),
+        "uniform_smart_p99_ms": round(uni_smart_p99, 3),
+        "uniform_router_gets_per_s": round(uni_router_rate, 1),
+        "uniform_router_p99_ms": round(uni_router_p99, 3),
+        "uniform_p99_overhead_pct": round(overhead_pct, 1),
+        "skew_smart_gets_per_s": round(skew_smart_rate, 1),
+        "skew_smart_p99_ms": round(skew_smart_p99, 3),
+        "skew_router_gets_per_s": round(skew_router_rate, 1),
+        "skew_router_p99_ms": round(skew_router_p99, 3),
+        "router_wins_at_skew": wins,
+        "target": 15.0,
+        "target_met": bool(wins and overhead_pct < 15.0),
+    }
+
+
 def _metrics_blob() -> dict:
     """Counters + span aggregates at this instant (cumulative within the
     run) — embedded in every emitted JSON record. Histogram buckets are
@@ -2579,6 +2960,24 @@ def _run(backend: str) -> None:
         )
     except Exception as e:
         print(f"# rebalance_live_split bench failed: {e!r}",
+              file=sys.stderr)
+    try:
+        configs.append(
+            bench_router_pipelined_throughput(
+                bursts=40 if on_tpu else 15
+            )
+        )
+    except Exception as e:
+        print(f"# router_pipelined_throughput bench failed: {e!r}",
+              file=sys.stderr)
+    try:
+        configs.append(
+            bench_router_hotkey_skew(
+                duration_s=2.0 if on_tpu else 1.2
+            )
+        )
+    except Exception as e:
+        print(f"# router_hotkey_skew bench failed: {e!r}",
               file=sys.stderr)
 
     # Every emitted record carries the run's metrics snapshot (counters +

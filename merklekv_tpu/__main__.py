@@ -38,10 +38,13 @@ def main(argv: list[str] | None = None) -> int:
 
         return blackbox_main(argv[1:])
     if argv and argv[0] == "router":
-        # Thin partition router: one address dumb clients can point at in
-        # a partitioned cluster (docs/PROTOCOL.md "Partitioned cluster
-        # mode"); smart clients route themselves and skip this hop.
-        from merklekv_tpu.cluster.router import main as router_main
+        # Request plane: one address dumb clients can point at in a
+        # partitioned cluster — pooled epoll io workers, pipelined
+        # per-partition fan-out, optional lease-guarded read cache
+        # (docs/PROTOCOL.md "Router semantics"); smart clients route
+        # themselves and skip this hop. --legacy-threads runs the old
+        # thread-per-connection thin router (the measured A/B baseline).
+        from merklekv_tpu.requestplane.router import main as router_main
 
         return router_main(argv[1:])
     if argv and argv[0] == "rebalance":

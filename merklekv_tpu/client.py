@@ -500,6 +500,44 @@ class MerkleKVClient:
     def get(self, key: str) -> Optional[str]:
         return _parse_value(self._request(f"GET {key}"))
 
+    def get_stamped(
+        self, key: str, force: bool = False
+    ) -> tuple[Optional[str], Optional[tuple[int, int]]]:
+        """GET through the request plane with the staleness stamp: asks
+        the router to answer ``VALUE vs=<age_ms>:<bound_ms> <value>`` so
+        the caller can SEE how stale a cached answer may be —
+        ``age_ms`` is the cache entry's age at serve time, ``bound_ms``
+        the router's hard max-age bound (an answer is never served past
+        it; docs/PROTOCOL.md "Router semantics"). Returns
+        ``(value, (age_ms, bound_ms))``; the stamp is None when the
+        peer has no cache hop (plain node, cache off) or on NOT_FOUND.
+        ``force=True`` (vs=03) bypasses and drops the cached entry —
+        the answer is read fresh from the owning partition."""
+        tok = "vs=03" if force else "vs=01"
+        try:
+            resp = _parse_simple(self._request(f"GET {key} {tok}"))
+        except (ServerBusyError, ReadOnlyError, MovedError):
+            raise
+        except ProtocolError:
+            # Peer rejects the token (plain node / old router): its live
+            # answer is exact — nothing to stamp. One retry, settled.
+            return _parse_value(self._request(f"GET {key}")), None
+        if resp == "NOT_FOUND":
+            return None, None
+        if resp.startswith("VALUE "):
+            body = resp[6:]
+            if body.startswith("vs="):
+                stamp_s, _, value = body.partition(" ")
+                try:
+                    age_s, bound_s = stamp_s[3:].split(":")
+                    return value, (int(age_s), int(bound_s))
+                except ValueError as e:
+                    raise ProtocolError(
+                        f"malformed GET stamp: {resp!r}"
+                    ) from e
+            return body, None
+        raise ProtocolError(f"unexpected response: {resp}")
+
     def set(self, key: str, value: str) -> bool:
         resp = _parse_simple(self._request(f"SET {key} {value}"))
         if resp != "OK":

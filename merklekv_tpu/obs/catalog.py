@@ -254,6 +254,70 @@ CATALOG: dict[str, tuple[str, str]] = {
     "router.backend_errors": (
         "counter", "Router commands failed by an unreachable/failing "
         "backend replica."),
+    # -- request plane (pipelined epoll router + read leases) ---------------
+    "router.busy_retries": (
+        "counter", "Router commands that hit ERROR BUSY upstream and "
+        "retried after backoff (bounded by the PARTITION_MOVED budget)."),
+    "router.upstream_dials": (
+        "counter", "Pooled upstream connections dialed (first use or "
+        "redial after a reset; replica failover rotates the order)."),
+    "router.upstream_resets": (
+        "counter", "Pooled upstream connections torn down (peer death, "
+        "response timeout, desync) — every in-flight sub-request on the "
+        "connection fails retryable."),
+    "router.fanout_subrequests": (
+        "counter", "Per-partition sub-requests dispatched by multi-key "
+        "fan-out (MGET/MSET/EXISTS/SCAN/DBSIZE)."),
+    "router.cache_hits": (
+        "counter", "GETs answered from the router read cache."),
+    "router.cache_misses": (
+        "counter", "GETs that missed the read cache and took a fill "
+        "lease upstream."),
+    "router.cache_fills": (
+        "counter", "Lease fills that stored a value in the read cache."),
+    "router.cache_expired": (
+        "counter", "Cache entries dropped at read time for lapsing the "
+        "hard max-age staleness bound."),
+    "router.cache_evictions": (
+        "counter", "LRU evictions forced by the cache byte budget."),
+    "router.cache_invalidations": (
+        "counter", "Cache entries dropped by write-through, replication "
+        "events, gap flushes, or epoch clears."),
+    "router.lease_grants": ("counter", "Fill leases handed out (one per "
+                            "missed key; herd followers wait instead)."),
+    "router.lease_waits": (
+        "counter", "GETs that queued behind an in-flight fill lease "
+        "(the thundering herd the lease absorbed)."),
+    "router.lease_timeouts": (
+        "counter", "Leases stolen after the holder exceeded the fill "
+        "timeout (presumed-dead filler)."),
+    "router.lease_failures": (
+        "counter", "Lease fills that completed with an upstream error "
+        "(waiters got the error, nothing cached)."),
+    "router.inval_frames": (
+        "counter", "Replication envelopes consumed by the router's "
+        "invalidation feed."),
+    "router.inval_decode_errors": (
+        "counter", "Replication envelopes the invalidation feed could "
+        "not decode (dropped; max-age bound still holds)."),
+    "router.inval_gap_flushes": (
+        "counter", "Partition-wide cache flushes forced by a detected "
+        "hseq gap (missed invalidation frames)."),
+    "router.inval_lag": (
+        "histogram", "Publish-to-apply latency of invalidation frames "
+        "(publisher hts to router apply)."),
+    "router.conns": (
+        "gauge", "Client connections currently owned by the router's io "
+        "workers."),
+    "router.workers": ("gauge", "Router io worker pool width."),
+    "router.inval_lag_ms": (
+        "gauge", "Invalidation lag of the most recent frame, ms (-1 = "
+        "no feed attached)."),
+    "router.cache_bytes": (
+        "gauge", "Router read-cache bytes used (entry-accounted)."),
+    "router.cache_keys": ("gauge", "Router read-cache entries resident."),
+    "router.leases_inflight": (
+        "gauge", "Fill leases currently outstanding."),
     # -- overload protection ------------------------------------------------
     "node.degradation_changes": (
         "counter", "Degradation-ladder transitions (live/shedding/"

@@ -34,7 +34,13 @@ from typing import Optional
 
 from merklekv_tpu.client import MerkleKVClient, MerkleKVError
 
-__all__ = ["NodeSample", "sample_node", "render_table", "main"]
+__all__ = [
+    "NodeSample",
+    "sample_node",
+    "render_table",
+    "render_router_pane",
+    "main",
+]
 
 _CLEAR = "\x1b[2J\x1b[H"
 
@@ -94,6 +100,16 @@ class NodeSample:
     # cumulative bytes the io workers flushed to sockets — rendered as the
     # SRV_MB/S column (served-bytes rate; 0 on nodes predating the pool).
     served_bytes: int = 0
+    # Request plane (INFO role:router + METRICS router.* lines): routers
+    # polled alongside nodes render in their own pane — conns/worker via
+    # the shared CONNS/W fields, plus cache hit rate, lease waits, and
+    # invalidation lag (docs/OBSERVABILITY.md).
+    is_router: bool = False
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_keys: int = 0
+    lease_waits: int = 0
+    inval_lag_ms: float = -1.0
     # Flight-recorder pane (--events): newest black-box events via the
     # FLIGHT verb, one dict per event ([] on nodes predating the verb or
     # when --events is off).
@@ -208,6 +224,19 @@ def sample_node(
                 s.lag_ms = max(s.lag_ms, float(value))
         except ValueError:
             continue
+    s.is_router = info.get("role") == "router"
+    if s.is_router:
+        for attr, key, cast in (
+            ("cache_hits", "router.cache_hits", int),
+            ("cache_misses", "router.cache_misses", int),
+            ("cache_keys", "router.cache_keys", int),
+            ("lease_waits", "router.lease_waits", int),
+            ("inval_lag_ms", "router.inval_lag_ms", float),
+        ):
+            try:
+                setattr(s, attr, cast(metrics[key]))
+            except (KeyError, ValueError):
+                pass  # cache off / no invalidation feed attached
     return s
 
 
@@ -246,6 +275,50 @@ def render_events_pane(cur: dict[str, NodeSample]) -> str:
     )
 
 
+def render_router_pane(
+    prev: dict[str, NodeSample], cur: dict[str, NodeSample]
+) -> str:
+    """Request-plane pane: rendered whenever a polled address turns out
+    to be a router (INFO role:router). CONNS/W/OPS-S-W read like the
+    node table; HIT% is the interval cache hit rate, LEASE_W/S the herd
+    the leases absorbed, INVAL_MS the newest invalidation frame's
+    publish-to-apply lag (-1 = no feed attached)."""
+    header = (
+        f"{'ROUTER':<22} {'CONNS':>5} {'W':>3} {'OPS/S':>8} "
+        f"{'OPS/S/W':>8} {'HIT%':>6} {'KEYS':>7} {'LEASE_W/S':>10} "
+        f"{'INVAL_MS':>9} STATUS"
+    )
+    lines = ["", "-- request plane " + "-" * 46, header]
+    for node, c in cur.items():
+        if not c.ok:
+            continue
+        p = prev.get(node)
+        dt = (c.unix - p.unix) if (p is not None and p.ok) else 0.0
+        ops = _rate(c.total_commands, p.total_commands, dt) if dt else 0.0
+        per_worker = 0.0
+        if dt and c.worker_commands:
+            per_worker = max(
+                _rate(v, p.worker_commands.get(k, v), dt)
+                for k, v in c.worker_commands.items()
+            )
+        hits = _rate(c.cache_hits, p.cache_hits, dt) if dt else 0.0
+        misses = _rate(c.cache_misses, p.cache_misses, dt) if dt else 0.0
+        hit_pct = (
+            f"{100.0 * hits / (hits + misses):.1f}"
+            if hits + misses > 0
+            else "-"
+        )
+        lease_w = _rate(c.lease_waits, p.lease_waits, dt) if dt else 0.0
+        inval = f"{c.inval_lag_ms:.1f}" if c.inval_lag_ms >= 0 else "-"
+        w = str(c.io_threads) if c.io_threads else "-"
+        lines.append(
+            f"{node:<22} {c.active_connections:>5} {w:>3} {ops:>8.1f} "
+            f"{per_worker:>8.1f} {hit_pct:>6} {c.cache_keys:>7} "
+            f"{lease_w:>10.1f} {inval:>9} UP"
+        )
+    return "\n".join(lines)
+
+
 def render_table(
     prev: dict[str, NodeSample], cur: dict[str, NodeSample]
 ) -> str:
@@ -263,6 +336,8 @@ def render_table(
     for node in cur:
         c = cur[node]
         p = prev.get(node)
+        if c.ok and c.is_router:
+            continue  # routers render in their own pane
         if not c.ok:
             lines.append(f"{node:<22} {'-':>4} {'-':>9} {'-':>8} {'-':>8} "
                          f"{'-':>8} "
@@ -378,6 +453,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             time.sleep(max(0.05, args.interval))
             cur = take()
             frame = render_table(prev, cur)
+            if any(s.ok and s.is_router for s in cur.values()):
+                frame += render_router_pane(prev, cur)
             if args.events:
                 frame += render_events_pane(cur)
             if args.once:

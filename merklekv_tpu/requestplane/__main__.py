@@ -1,0 +1,8 @@
+"""`python -m merklekv_tpu.requestplane` — run the pooled router."""
+
+import sys
+
+from merklekv_tpu.requestplane.router import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -91,6 +91,19 @@ def test_direction_rules():
     assert bench_gate.lower_is_better("large_value_alloc_per_op",
                                       "allocs/op")
     assert bench_gate.lower_is_better("anything_per_op", "")
+    # Request-plane scenarios (PR 17) gate as throughput: the pooled
+    # router's pipelined ops/s and the skewed-load cached GET rate must
+    # not DROP — an io-plane change that serializes the upstream fan-out
+    # or breaks the lease cache is what these directions pin.
+    assert not bench_gate.lower_is_better(
+        "router_pipelined_throughput",
+        "ops/s (64 conns x pipelined GET/SET via router, depth 32)",
+    )
+    assert not bench_gate.lower_is_better(
+        "router_hotkey_skew",
+        "gets/s (router, Zipf(0.5) over 512 keys, 4ms emulated "
+        "partition RTT)",
+    )
 
 
 def test_compare_flags_only_real_regressions():
@@ -144,3 +157,45 @@ def test_main_gates_on_committed_rounds_in_repo():
     runs exactly this)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     assert bench_gate.main(["--dir", repo]) == 0
+
+
+def test_router_pipelined_throughput_runs_green_on_cpu():
+    """Weather test: the request-plane io A/B scenario must RUN on a
+    plain CPU box at reduced size — cluster spin-up, both router builds,
+    the burst drive, and teardown all green, emitting a gateable record
+    (usable value, both sides present). Perf targets are the real-size
+    run's business, not this one's."""
+    import bench
+
+    rec = bench.bench_router_pipelined_throughput(
+        n_conns=16, depth=8, bursts=4
+    )
+    assert rec["metric"] == "router_pipelined_throughput"
+    assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+    assert rec["pooled_ops_per_s"] > 0
+    assert rec["legacy_ops_per_s"] > 0
+    assert rec["speedup_x"] > 0
+    assert not bench_gate.lower_is_better(rec["metric"], rec["unit"])
+
+
+def test_router_hotkey_skew_runs_green_on_cpu():
+    """Weather test: the Zipfian A/B scenario must RUN on a plain CPU
+    box at reduced size — delay proxies, replication feed, lease cache,
+    all four corners measured, teardown green. Direction sanity rides
+    along; the uniform/skew acceptance corners are the real-size run's
+    business."""
+    import bench
+
+    rec = bench.bench_router_hotkey_skew(
+        duration_s=0.4, n_keys=128, readers=4, rtt_ms=2.0, workers=2,
+        cache_entries=48,
+    )
+    assert rec["metric"] == "router_hotkey_skew"
+    assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+    for corner in (
+        "uniform_smart_gets_per_s", "uniform_router_gets_per_s",
+        "skew_smart_gets_per_s", "skew_router_gets_per_s",
+    ):
+        assert rec[corner] > 0
+    assert rec["uniform_router_p99_ms"] > 0
+    assert not bench_gate.lower_is_better(rec["metric"], rec["unit"])
